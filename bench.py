@@ -1,0 +1,112 @@
+"""Benchmark: MNIST-MLP training throughput through the full capsule stack.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+Baseline: the same 784-512-256-10 MLP, batch 1024, SGD, trained with
+torch-CPU (BASELINE.json configs[0] "single-device CPU ref"), measured on
+this host at 35768 samples/sec — see BASELINE.md. ``vs_baseline`` is the
+ratio of this framework's per-chip throughput to that number.
+
+Run on whatever ``jax.devices()`` exposes (the driver runs it on one real TPU
+chip); all devices are put on a data-parallel mesh axis and throughput is
+normalized per chip.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.datasets import ArrayDataset
+from rocket_tpu.models.mlp import MLP
+
+TORCH_CPU_BASELINE_SAMPLES_PER_SEC = 35768.0
+
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+class Timer(rt.Capsule):
+    """Starts the clock after `warmup` steps (past compile), device-synced."""
+
+    def __init__(self, module, warmup: int):
+        super().__init__(priority=50)  # after all work capsules
+        self._module = module
+        self._warmup = warmup
+        self.count = 0
+        self.t0 = None
+
+    def launch(self, attrs=None):
+        self.count += 1
+        self.last_params = self._module.state["params"]
+        if self.count == self._warmup:
+            jax.block_until_ready(self.last_params)
+            self.t0 = time.perf_counter()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=1024)
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--steps", type=int, default=60)
+    args = parser.parse_args()
+
+    n_dev = len(jax.devices())
+    runtime = rt.Runtime(seed=0)
+
+    total = args.batch * (args.warmup + args.steps)
+    rng = np.random.default_rng(0)
+    data = ArrayDataset(
+        rng.normal(size=(total, 784)).astype(np.float32),
+        rng.integers(0, 10, size=total).astype(np.int32),
+    )
+
+    model = MLP(in_features=784, num_classes=10, hidden=(512, 256))
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.sgd(), learning_rate=0.01)],
+    )
+    timer = Timer(module, warmup=args.warmup)
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [rt.Dataset(data, batch_size=args.batch), module, timer],
+                tag="train",
+                progress=False,
+            )
+        ],
+        num_epochs=1,
+        runtime=runtime,
+    )
+
+    launcher.launch()
+
+    jax.block_until_ready(timer.last_params)
+    t1 = time.perf_counter()
+    elapsed = t1 - timer.t0
+    measured_samples = args.batch * args.steps
+    per_chip = measured_samples / elapsed / n_dev
+
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_samples_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(per_chip / TORCH_CPU_BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

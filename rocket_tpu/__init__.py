@@ -1,0 +1,48 @@
+"""rocket_tpu — a TPU-native, capsule-based training framework.
+
+Same capabilities and composition model as the ``bulatko/rocket`` reference —
+a training run is a tree of capsules driven through a five-event lifecycle,
+communicating via a shared ``Attributes`` bag — built idiomatically on
+JAX/XLA: the per-iteration array work is one jitted, donated-argument step
+function sharded over a ``jax.sharding.Mesh`` with collectives over ICI/DCN.
+"""
+
+from rocket_tpu.core import (
+    Attributes,
+    Capsule,
+    Checkpointer,
+    Dataset,
+    Dispatcher,
+    Events,
+    Launcher,
+    Looper,
+    Loss,
+    Meter,
+    Metric,
+    Module,
+    Optimizer,
+    Scheduler,
+    Tracker,
+)
+from rocket_tpu.runtime.context import Runtime
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Attributes",
+    "Capsule",
+    "Checkpointer",
+    "Dataset",
+    "Dispatcher",
+    "Events",
+    "Launcher",
+    "Looper",
+    "Loss",
+    "Meter",
+    "Metric",
+    "Module",
+    "Optimizer",
+    "Runtime",
+    "Scheduler",
+    "Tracker",
+]

@@ -1,0 +1,34 @@
+"""Core capsule system — the 11 public names of the reference API
+(``rocket/core/__init__.py:1-11``) plus the TPU runtime extras."""
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule, Events
+from rocket_tpu.core.checkpoint import Checkpointer
+from rocket_tpu.core.dataset import Dataset
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.core.launcher import Launcher
+from rocket_tpu.core.loop import Looper
+from rocket_tpu.core.loss import Loss
+from rocket_tpu.core.meter import Meter, Metric
+from rocket_tpu.core.module import Module
+from rocket_tpu.core.optimizer import Optimizer
+from rocket_tpu.core.scheduler import Scheduler
+from rocket_tpu.core.tracker import Tracker
+
+__all__ = [
+    "Attributes",
+    "Capsule",
+    "Checkpointer",
+    "Dataset",
+    "Dispatcher",
+    "Events",
+    "Launcher",
+    "Looper",
+    "Loss",
+    "Meter",
+    "Metric",
+    "Module",
+    "Optimizer",
+    "Scheduler",
+    "Tracker",
+]

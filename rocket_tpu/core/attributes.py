@@ -1,0 +1,105 @@
+"""Attributes — the shared mutable dataflow bag threaded through every event call.
+
+The reference delegates this to the external ``adict`` package
+(``rocket/core/capsule.py:11``): a dict with attribute-style access where a
+*missing key reads as None*. Every capsule leans on that contract (e.g.
+``rocket/core/dataset.py:98``, ``rocket/core/loss.py:42-45``), so this is a
+first-class, dependency-free implementation with the same semantics.
+
+Values placed in the bag are arbitrary Python objects; on the hot path they are
+JAX arrays or pytrees of JAX arrays, and the bag itself stays host-side — it is
+never traced.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterator, Mapping
+
+
+class Attributes(dict):
+    """A dict with attribute get/set/del where a missing key reads as ``None``.
+
+    >>> attrs = Attributes()
+    >>> attrs.batch is None        # missing key -> None, never AttributeError
+    True
+    >>> attrs.batch = [1, 2]
+    >>> attrs["batch"]
+    [1, 2]
+    >>> del attrs.batch
+    >>> attrs.batch is None
+    True
+
+    Nested dicts assigned into the bag are wrapped on *read* so that chained
+    access (``attrs.looper.state.loss``) works regardless of how the inner
+    mapping was created.
+    """
+
+    __slots__ = ()
+
+    def __getattr__(self, name: str) -> Any:
+        # Called only when normal attribute lookup fails -> treat as key.
+        if name.startswith("__") and name.endswith("__"):
+            # Preserve protocol behavior (pickle, copy, ...).
+            raise AttributeError(name)
+        value = self.get(name, None)
+        if type(value) is dict:
+            # Wrap in place so subsequent writes through the wrapper stick.
+            value = Attributes(value)
+            self[name] = value
+        return value
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        # Deleting a missing key is a no-op, matching the missing->None reads.
+        self.pop(name, None)
+
+    def __getitem__(self, key: Any) -> Any:
+        return self.get(key, None) if key not in self else super().__getitem__(key)
+
+    # -- convenience -------------------------------------------------------
+
+    def copy(self) -> "Attributes":
+        return Attributes(self)
+
+    def deepcopy(self) -> "Attributes":
+        return copy.deepcopy(self)
+
+    def flat_items(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+        """Yield ``("a.b.c", value)`` pairs for nested mappings (logging aid)."""
+        for key, value in self.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, Mapping) and value:
+                yield from Attributes(value).flat_items(prefix=path + ".")
+            else:
+                yield path, value
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        return f"Attributes({inner})"
+
+
+# Register as a jax pytree node (sorted keys, like dict) so an Attributes bag
+# holding arrays — e.g. a batch — can cross the jit boundary transparently.
+def _attrs_flatten_with_keys(obj: Attributes):
+    import jax
+
+    keys = sorted(obj.keys(), key=str)
+    return [(jax.tree_util.DictKey(k), obj[k]) for k in keys], tuple(keys)
+
+
+def _attrs_unflatten(keys, children) -> Attributes:
+    return Attributes(zip(keys, children))
+
+
+def _register_pytree() -> None:
+    import jax
+
+    jax.tree_util.register_pytree_with_keys(
+        Attributes, _attrs_flatten_with_keys, _attrs_unflatten
+    )
+
+
+_register_pytree()

@@ -1,0 +1,188 @@
+"""Capsule — the base unit of composition, and the five-event lifecycle.
+
+Reference semantics (``rocket/core/capsule.py``):
+
+* ``Events`` enum with string values naming the handler methods
+  (``capsule.py:14-19``); ``dispatch()`` is ``getattr(self, event.value)(attrs)``
+  (``capsule.py:97-98``).
+* A capsule holds a priority (default 1000, ``capsule.py:28``), a statefulness
+  flag, a late-bound runtime handle (``capsule.py:101-102``) and a rank-aware
+  logger (``capsule.py:33``).
+* ``setup`` pushes stateful capsules onto the runtime's checkpoint stack
+  (``capsule.py:40-46``); ``destroy`` pops that stack in reverse and verifies
+  identity (``capsule.py:56-64``).
+
+Deviations from the reference (deliberate fixes, see SURVEY.md §2c):
+
+* base ``state_dict``/``load_state_dict`` are real methods (the reference's
+  stubs are missing ``self``, ``capsule.py:116-120``);
+* the runtime handle is our TPU ``Runtime`` (mesh/process topology/registries)
+  instead of a HuggingFace ``Accelerator``.
+"""
+
+from __future__ import annotations
+
+import logging
+from enum import Enum
+from typing import Optional
+
+from rocket_tpu.core.attributes import Attributes
+
+__all__ = ["Events", "Capsule", "Attributes"]
+
+
+class Events(Enum):
+    """Lifecycle events. Values are handler-method names (dispatch contract)."""
+
+    SETUP = "setup"
+    DESTROY = "destroy"
+    SET = "set"
+    RESET = "reset"
+    LAUNCH = "launch"
+
+
+# Priority conventions carried over from the reference tree
+# (loss.py:14, capsule.py:28, tracker.py:19, checkpoint.py:16):
+# within one Dispatcher, higher priority runs earlier.
+PRIORITY_LOSS = 1100
+PRIORITY_DEFAULT = 1000
+PRIORITY_TRACKER = 200
+PRIORITY_CHECKPOINT = 100
+
+
+class Capsule:
+    """Base unit: receives the five events, reads/writes the ``Attributes`` bag.
+
+    Parameters
+    ----------
+    statefull:
+        When True the capsule participates in checkpointing: ``setup``
+        registers it with the runtime's checkpoint stack and its
+        ``state_dict``/``load_state_dict`` are saved/restored. (Spelling kept
+        from the reference API, ``launcher.py:17``.)
+    priority:
+        Dispatch order inside a Dispatcher — higher runs earlier.
+    runtime:
+        Optional TPU runtime context; usually late-bound by the root
+        ``Launcher`` via :meth:`bind`.
+    """
+
+    def __init__(
+        self,
+        statefull: bool = False,
+        priority: int = PRIORITY_DEFAULT,
+        runtime: Optional["Runtime"] = None,  # noqa: F821 - forward ref
+    ) -> None:
+        self._priority = priority
+        self._statefull = statefull
+        self._runtime = runtime
+        self._logger = logging.getLogger(type(self).__name__)
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def priority(self) -> int:
+        return self._priority
+
+    @property
+    def statefull(self) -> bool:
+        return self._statefull
+
+    @property
+    def runtime(self):
+        return self._runtime
+
+    # -- event handlers ----------------------------------------------------
+
+    def setup(self, attrs: Attributes | None = None) -> None:
+        """One-time initialization; stateful capsules join the checkpoint stack."""
+        self._check_runtime()
+        self.log_debug("setup")
+        if self._statefull:
+            self._runtime.register_for_checkpointing(self)
+
+    def set(self, attrs: Attributes | None = None) -> None:
+        """Per-epoch (or per-phase) preparation."""
+        self.log_debug("set")
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        """The per-iteration work unit."""
+        self.log_debug("launch")
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        """Per-epoch teardown."""
+        self.log_debug("reset")
+
+    def destroy(self, attrs: Attributes | None = None) -> None:
+        """Final teardown; stateful capsules unwind the checkpoint stack.
+
+        The stack is popped in reverse registration order and identity-checked,
+        mirroring ``capsule.py:56-64``.
+        """
+        self.log_debug("destroy")
+        if self._statefull and self._runtime is not None:
+            self._runtime.unregister_from_checkpointing(self)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(self, event: Events, attrs: Attributes | None = None) -> None:
+        """Route an event to its handler method (``capsule.py:97-98``)."""
+        if not isinstance(event, Events):
+            raise RuntimeError(
+                f"{type(self).__name__}: dispatch expects an Events member, "
+                f"got {event!r}"
+            )
+        getattr(self, event.value)(attrs)
+
+    # -- runtime binding ---------------------------------------------------
+
+    def bind(self, runtime) -> None:
+        """Late-bind the runtime context (reference ``accelerate()``,
+        ``capsule.py:101-102``). Idempotent for the same runtime; rebinding to
+        a different runtime is an error."""
+        if self._runtime is not None and self._runtime is not runtime:
+            raise RuntimeError(
+                f"{type(self).__name__}: already bound to a different runtime."
+            )
+        self._runtime = runtime
+        self._logger = runtime.get_logger(type(self).__name__)
+
+    def _check_runtime(self) -> None:
+        if self._runtime is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: no runtime bound. Construct the tree "
+                "under a Launcher (which binds its runtime recursively) or "
+                "call .bind(runtime) explicitly."
+            )
+
+    # -- checkpoint state --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Host-side state to persist. Stateful subclasses override."""
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore host-side state. Stateful subclasses override."""
+        del state
+
+    # -- logging -----------------------------------------------------------
+
+    def log_debug(self, msg: str) -> None:
+        self._logger.debug("%s: %s", type(self).__name__, msg)
+
+    def log_info(self, msg: str) -> None:
+        self._logger.info("%s: %s", type(self).__name__, msg)
+
+    def log_warning(self, msg: str) -> None:
+        self._logger.warning("%s: %s", type(self).__name__, msg)
+
+    # -- introspection -----------------------------------------------------
+
+    def __repr__(self) -> str:
+        flags = []
+        if self._statefull:
+            flags.append("statefull")
+        if self._priority != PRIORITY_DEFAULT:
+            flags.append(f"priority={self._priority}")
+        suffix = f" [{', '.join(flags)}]" if flags else ""
+        return f"{type(self).__name__}{suffix}"

@@ -1,0 +1,168 @@
+"""Checkpointer capsule — periodic save, resume, selective capsule restore.
+
+Reference semantics (``rocket/core/checkpoint.py``):
+
+* priority 100 — runs near-last in the iteration wave (``checkpoint.py:16``);
+* ``setup()`` resumes from ``resume_from``; ``resume_capsules=False`` restores
+  only model/optimizer state, skipping the capsule stack
+  (``checkpoint.py:30-46``);
+* ``launch()`` saves every ``save_every`` iterations into
+  ``output_dir/<iter_idx>/`` (``checkpoint.py:57-73``);
+* stateful ``iter_idx`` (``checkpoint.py:76-82``).
+
+Deliberate fix: the reference early-returns on non-main processes so its
+barrier is rank-0-only and non-main ranks never save (``checkpoint.py:53-63``)
+— a deadlock in real multiprocess runs. Here every process runs the save path
+(the writer is main-process-gated inside, the barrier is global).
+
+Layout per step (analogue of the reference's verified layout, SURVEY §3.3):
+``<output_dir>/<iter_idx>/model_{k}.pkl`` (one TrainState pytree per prepared
+model — params, optimizer moments, model state, PRNG base key, step),
+``capsules.pkl`` (the stateful-capsule stack states, in setup order) and
+``rng.pkl`` (runtime key counter).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import PRIORITY_CHECKPOINT, Capsule
+from rocket_tpu.runtime import checkpoint_io
+
+__all__ = ["Checkpointer"]
+
+
+class Checkpointer(Capsule):
+    def __init__(
+        self,
+        output_dir: str = "checkpoints",
+        save_every: int = 1000,
+        resume_from: Optional[str] = None,
+        resume_capsules: bool = True,
+        keep_last: Optional[int] = None,
+        statefull: bool = True,
+        priority: int = PRIORITY_CHECKPOINT,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        self._output_dir = output_dir
+        self._save_every = save_every
+        self._resume_from = resume_from
+        self._resume_capsules = resume_capsules
+        self._keep_last = keep_last
+        self._iter_idx = 0
+        self._saved_steps: list[int] = []
+
+    # -- events ------------------------------------------------------------
+
+    def setup(self, attrs: Attributes | None = None) -> None:
+        super().setup(attrs)
+        if self._resume_from:
+            self._load(self._resume_from)
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        self._iter_idx += 1
+        if self._iter_idx % self._save_every != 0:
+            return
+        self.save()
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: Optional[int] = None) -> str:
+        """Write one checkpoint directory; returns its path."""
+        runtime = self._runtime
+        step = self._iter_idx if step is None else step
+        path = os.path.join(self._output_dir, str(step))
+
+        # ALL processes reach the barrier (fixes checkpoint.py:53-63) and run
+        # the materialize phase — cross-host-sharded arrays are gathered with
+        # a collective, so every rank must participate; only the main process
+        # writes the files.
+        # Record this step BEFORE snapshotting capsule states so the
+        # checkpoint's own entry survives a resume and gets pruned later.
+        self._saved_steps.append(step)
+
+        runtime.wait_for_everyone()
+        model_states = [
+            checkpoint_io.materialize_pytree(prepared.state)
+            for prepared in runtime.models.values()
+        ]
+        if runtime.is_main_process:
+            import pickle
+
+            os.makedirs(path, exist_ok=True)
+            for k, host_state in enumerate(model_states):
+                checkpoint_io.atomic_write(
+                    os.path.join(path, f"model_{k}.pkl"),
+                    pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL),
+                )
+            capsule_states = [obj.state_dict() for obj in runtime.checkpoint_stack]
+            checkpoint_io.atomic_write(
+                os.path.join(path, "capsules.pkl"), pickle.dumps(capsule_states)
+            )
+            checkpoint_io.save_pytree(
+                os.path.join(path, "rng.pkl"), runtime.rng_state_dict()
+            )
+        runtime.wait_for_everyone()
+
+        if self._keep_last is not None and runtime.is_main_process:
+            while len(self._saved_steps) > self._keep_last:
+                old = self._saved_steps.pop(0)
+                old_path = os.path.join(self._output_dir, str(old))
+                import shutil
+
+                shutil.rmtree(old_path, ignore_errors=True)
+        self.log_info(f"saved checkpoint at {path}")
+        return path
+
+    # -- restore -----------------------------------------------------------
+
+    def _load(self, path: str) -> None:
+        runtime = self._runtime
+        if not os.path.isdir(path):
+            raise RuntimeError(f"Checkpointer: resume_from {path!r} does not exist.")
+
+        for k, prepared in enumerate(runtime.models.values()):
+            model_path = os.path.join(path, f"model_{k}.pkl")
+            if os.path.exists(model_path):
+                prepared.state = checkpoint_io.load_pytree(
+                    model_path, template=prepared.state
+                )
+
+        rng_path = os.path.join(path, "rng.pkl")
+        if os.path.exists(rng_path):
+            runtime.load_rng_state_dict(checkpoint_io.load_pytree(rng_path))
+
+        if self._resume_capsules:
+            capsule_path = os.path.join(path, "capsules.pkl")
+            if os.path.exists(capsule_path):
+                import pickle
+
+                with open(capsule_path, "rb") as f:
+                    capsule_states = pickle.load(f)
+                stack = runtime.checkpoint_stack
+                if len(capsule_states) != len(stack):
+                    # Selective restore tolerates tree changes, mirroring the
+                    # reference's swallowed count-mismatch (checkpoint.py:38-46)
+                    # but loudly.
+                    self.log_warning(
+                        f"capsule count mismatch: checkpoint has "
+                        f"{len(capsule_states)}, tree has {len(stack)}; "
+                        "restoring the common prefix."
+                    )
+                for obj, state in zip(stack, capsule_states):
+                    obj.load_state_dict(state)
+        self.log_info(f"resumed from {path}")
+
+    # -- checkpoint state --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"iter_idx": self._iter_idx, "saved_steps": list(self._saved_steps)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._iter_idx = int(state["iter_idx"])
+        # Restore the rotation list so keep_last keeps pruning checkpoints
+        # written before the resume.
+        self._saved_steps = [int(s) for s in state.get("saved_steps", [])]

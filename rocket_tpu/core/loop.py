@@ -1,0 +1,220 @@
+"""Looper — the per-phase iteration loop (one per train/val/test phase).
+
+Reference semantics (``rocket/core/loop.py``):
+
+* ``set()`` infers the iteration count by summing child ``Dataset`` totals
+  (``loop.py:113-125``), errors on infinite loops (``loop.py:48-51``), and
+  publishes the loop contract ``attrs.looper = {repeats, state, terminate,
+  tag}`` (``loop.py:53-58``);
+* ``launch()`` shows a progress bar only on the local main process
+  (``loop.py:75-79``), then per iteration clears ``attrs.batch``, runs the
+  children as one dispatch wave, breaks on ``attrs.looper.terminate``
+  (``loop.py:81-90``) and mirrors ``attrs.looper.state`` into the bar postfix;
+* ``run_every`` gating skips whole epochs (``loop.py:34-39``); nested Loopers
+  are forbidden (``loop.py:106-111``); stateful ``epoch_idx``/``batch_idx``
+  (``loop.py:98-104``).
+
+Substrate deviation (SURVEY.md §7): JAX has no ambient autograd mode, so the
+reference's ``torch.set_grad_enabled(self._grad_enabled)`` (``loop.py:85``)
+becomes an explicit ``attrs.mode = "train" | "eval"`` that Module / Loss /
+Optimizer / Scheduler / Tracker / Dataset read from the bag.
+
+Deliberate fixes: repeats are re-inferred every epoch (the reference leaves
+``_repeats = -1`` after epoch one so later epochs never iterate,
+``loop.py:95``), and ``batch_idx`` actually advances (dead state in the
+reference, ``loop.py:103``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+
+__all__ = ["Looper"]
+
+
+class Looper(Dispatcher):
+    """Drives its children for ``repeats`` iterations per epoch.
+
+    Parameters
+    ----------
+    capsules:
+        One iteration = one priority-ordered dispatch wave over these.
+    tag:
+        Phase name (``"train"``, ``"val"`` ...) — keys tracker scalars and the
+        progress bar.
+    grad_enabled:
+        True -> ``attrs.mode = "train"`` (loss/optimizer/scheduler active);
+        False -> ``attrs.mode = "eval"``. Name kept from the reference API.
+    repeats:
+        Explicit iteration count; if None it is inferred each epoch from child
+        ``Dataset`` totals.
+    run_every:
+        Run this phase only on epochs where ``epoch_idx % run_every == 0``.
+    """
+
+    def __init__(
+        self,
+        capsules: Iterable[Capsule] = (),
+        tag: str = "train",
+        grad_enabled: bool = True,
+        repeats: Optional[int] = None,
+        run_every: int = 1,
+        progress: bool = True,
+        postfix_every: int = 1,
+        statefull: bool = True,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(capsules, statefull=statefull, priority=priority, runtime=runtime)
+        if run_every < 1:
+            raise RuntimeError(f"Looper: run_every must be >= 1, got {run_every}")
+        self._tag = tag
+        self._grad_enabled = grad_enabled
+        self._explicit_repeats = repeats
+        self._repeats: Optional[int] = repeats
+        self._run_every = run_every
+        self._progress = progress
+        # Formatting the postfix reads device scalars (a host sync); throttle
+        # it when benchmarking tight loops.
+        self._postfix_every = max(1, postfix_every)
+        self._epoch_idx = 0
+        self._batch_idx = 0  # mid-epoch position, persisted for resume
+        self._active = True  # run_every gate for the current epoch
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def tag(self) -> str:
+        return self._tag
+
+    @property
+    def mode(self) -> str:
+        return "train" if self._grad_enabled else "eval"
+
+    # -- guards ------------------------------------------------------------
+
+    def guard(self, capsules: Iterable[Capsule]) -> None:
+        super().guard(capsules)
+        for capsule in capsules:
+            if isinstance(capsule, Looper):
+                raise RuntimeError(
+                    "Looper: nested Loopers are forbidden (loop.py:106-111); "
+                    "compose phases side by side under the Launcher."
+                )
+
+    def _gated(self, attrs: Attributes | None) -> bool:
+        epoch = 0
+        if attrs is not None and attrs.launcher is not None:
+            epoch = attrs.launcher.epoch_idx or 0
+        return epoch % self._run_every != 0
+
+    # -- events ------------------------------------------------------------
+
+    def set(self, attrs: Attributes | None = None) -> None:
+        self._active = not self._gated(attrs)
+        if not self._active:
+            return
+        attrs = Attributes() if attrs is None else attrs
+
+        # Re-infer repeats every epoch unless explicitly pinned (fixes
+        # the reference's one-epoch bug, loop.py:45-46,95).
+        if self._explicit_repeats is None:
+            self._repeats = self._infer_repeats()
+        if self._repeats is None:
+            raise RuntimeError(
+                "Looper: cannot infer repeats — no child Dataset reports a "
+                "finite total; pass repeats= explicitly (loop.py:48-51)."
+            )
+
+        attrs.mode = self.mode
+        attrs.looper = Attributes(
+            repeats=self._repeats,
+            state=Attributes(),
+            terminate=False,
+            tag=self._tag,
+        )
+        super().set(attrs)
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if not self._active:
+            return
+        attrs = Attributes() if attrs is None else attrs
+        self.log_debug(f"launch: {self._repeats} iterations [{self._tag}]")
+
+        bar = self._progress_bar()
+        start = self._batch_idx  # >0 only on mid-epoch resume
+        try:
+            for _ in range(start, self._repeats):
+                attrs.batch = None
+                attrs.mode = self.mode
+                Dispatcher.launch(self, attrs)
+                if attrs.looper is not None and attrs.looper.terminate:
+                    break
+                self._batch_idx += 1
+                if bar is not None:
+                    bar.update(1)
+                    if (
+                        self._batch_idx % self._postfix_every == 0
+                        and attrs.looper is not None
+                        and attrs.looper.state
+                    ):
+                        bar.set_postfix(
+                            {k: f"{float(v):.4g}" for k, v in attrs.looper.state.items()},
+                            refresh=False,
+                        )
+        finally:
+            if bar is not None:
+                bar.close()
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        if not self._active:
+            return
+        self._epoch_idx += 1
+        self._batch_idx = 0
+        # Children reset first — epoch-end publishers (Metric.reset, the
+        # Tracker's final flush) still need the loop contract and its tag.
+        super().reset(attrs)
+        if attrs is not None:
+            attrs.mode = None
+            attrs.looper = None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _infer_repeats(self) -> Optional[int]:
+        """Sum child Dataset totals (loop.py:113-125)."""
+        from rocket_tpu.core.dataset import Dataset
+
+        totals = [d.total for d in self.find(Dataset)]
+        totals = [t for t in totals if t is not None]
+        return sum(totals) if totals else None
+
+    def _progress_bar(self):
+        """tqdm on the local main process only (loop.py:75-79)."""
+        if not self._progress:
+            return None
+        if self._runtime is not None and not self._runtime.is_local_main_process:
+            return None
+        try:
+            from tqdm import tqdm
+        except ImportError:  # pragma: no cover
+            return None
+        return tqdm(
+            total=self._repeats,
+            initial=self._batch_idx,
+            desc=self._tag,
+            leave=True,
+            dynamic_ncols=True,
+        )
+
+    # -- checkpoint state --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"epoch_idx": self._epoch_idx, "batch_idx": self._batch_idx}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch_idx = int(state["epoch_idx"])
+        self._batch_idx = int(state["batch_idx"])

@@ -1,0 +1,83 @@
+"""Loss capsule — the training objective and its running value.
+
+Reference semantics (``rocket/core/loss.py``):
+
+* wraps an objective mapping the *whole batch* to a scalar (``loss.py:34``);
+* priority 1100 so it runs before the Optimizer (``loss.py:14``);
+* train-only (``loss.py:30-31``);
+* cross-replica mean + accumulation ``_value += item()/accum_steps``
+  (``loss.py:36-37``); on the sync boundary publishes to
+  ``attrs.tracker.scalars[tag]`` and ``attrs.looper.state.loss`` then zeroes
+  (``loss.py:40-48``); stateful running value (``loss.py:53-57``).
+
+TPU substrate: the objective, the backward pass and the cross-replica mean run
+*inside* the Module's compiled step (the objective is a mean over the global
+mesh-sharded batch, so ``accelerator.gather(loss).mean()`` at ``loss.py:36``
+and ``accelerator.backward`` at ``loss.py:50`` have no host-side equivalents
+here). This capsule contributes the objective at setup and handles the
+host-side running value / publishing. The running value is accumulated as a
+**device scalar** — no per-iteration host sync; conversion to float happens
+only at checkpoint or tracker-flush time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import PRIORITY_LOSS, Capsule
+
+__all__ = ["Loss"]
+
+
+class Loss(Capsule):
+    def __init__(
+        self,
+        objective: Callable,
+        tag: str = "loss",
+        statefull: bool = True,
+        priority: int = PRIORITY_LOSS,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        if not callable(objective):
+            raise TypeError("Loss: objective must be callable (batch -> scalar).")
+        self._objective = objective
+        self._tag = tag
+        self._value = 0.0
+
+    @property
+    def objective(self) -> Callable:
+        return self._objective
+
+    @property
+    def tag(self) -> str:
+        return self._tag
+
+    # -- events ------------------------------------------------------------
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.mode != "train":
+            return  # train-only (loss.py:30-31)
+        if attrs.step_metrics is None or attrs.step_metrics.loss_window is None:
+            return
+        # The window accumulation itself runs inside the compiled step (the
+        # "loss_acc" slot of the TrainState, checkpointed with it) — issuing
+        # eager per-step scalar ops here would cost a device RPC each.
+        if attrs.sync_gradients:
+            value = attrs.step_metrics.loss_window  # device scalar, no sync
+            self._value = value
+            if attrs.tracker is not None:
+                attrs.tracker.scalars[self._tag] = value
+            if attrs.looper is not None:
+                attrs.looper.state.loss = value
+
+    # -- checkpoint state --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"value": float(jnp.asarray(self._value))}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._value = float(state["value"])

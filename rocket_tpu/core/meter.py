@@ -1,0 +1,118 @@
+"""Meter / Metric — gathered evaluation metrics.
+
+Reference semantics (``rocket/core/meter.py``):
+
+* ``Meter`` gathers selected batch keys across replicas with dataloader-padding
+  dedup (``gather_for_metrics``, ``meter.py:29-30``), writes the gathered
+  values back into a type-preserving clone of the batch (``meter.py:36-90``)
+  and dispatches its children — the ``Metric`` capsules — on the gathered
+  batch (``meter.py:95``);
+* ``Metric`` is the abstract user-subclassed accumulator: implement ``launch``
+  (accumulate) and ``reset`` (finalize/clear at epoch end) (``meter.py:98-111``).
+
+TPU substrate: under GSPMD a batch array is already one *global* logical array
+sharded over the mesh, so the cross-device gather is a ``jax.device_get`` on
+the addressable case and a ``process_allgather`` across hosts. Padding dedup
+uses ``attrs.batch_info.size`` — the real global sample count the DataLoader
+records when it wrap-pads the last batch (``data/loader.py``).
+
+Deliberate fix: errors inside metric children propagate — the reference's bare
+``except:`` masked them as "keys not found" (``meter.py:91-93``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+
+__all__ = ["Meter", "Metric"]
+
+
+class Meter(Dispatcher):
+    def __init__(
+        self,
+        keys: Sequence[str],
+        capsules: Iterable[Capsule] = (),
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(capsules, statefull=statefull, priority=priority, runtime=runtime)
+        self._keys = tuple(keys)
+
+    def gather_for_metrics(self, value, real_size: Optional[int]):
+        """All-replica gather with padding trim (``gather_for_metrics``)."""
+        if isinstance(value, jax.Array):
+            if value.is_fully_addressable:
+                host = np.asarray(jax.device_get(value))
+            else:
+                from jax.experimental import multihost_utils
+
+                host = np.asarray(multihost_utils.process_allgather(value))
+        else:
+            host = np.asarray(value)
+        if real_size is not None and host.ndim >= 1 and host.shape[0] > real_size:
+            host = host[:real_size]
+        return host
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.batch is None:
+            return
+        batch = attrs.batch
+        missing = [k for k in self._keys if not self._has_key(batch, k)]
+        if missing:
+            raise KeyError(
+                f"Meter: keys {missing} not found in batch "
+                f"(available: {self._available(batch)})"
+            )
+        real_size = None
+        if attrs.batch_info is not None:
+            real_size = attrs.batch_info.size
+
+        gathered = dict(batch) if isinstance(batch, dict) else {}
+        for key in self._keys:
+            gathered[key] = self.gather_for_metrics(batch[key], real_size)
+
+        # Children see the gathered batch; the device batch is restored after
+        # (meter.py:36-95's type-preserving clone semantics).
+        original = attrs.batch
+        attrs.batch = type(batch)(gathered) if isinstance(batch, dict) else gathered
+        try:
+            Dispatcher.launch(self, attrs)
+        finally:
+            attrs.batch = original
+
+    @staticmethod
+    def _has_key(batch, key) -> bool:
+        try:
+            return key in batch
+        except TypeError:
+            return False
+
+    @staticmethod
+    def _available(batch):
+        try:
+            return sorted(batch.keys())
+        except AttributeError:
+            return type(batch).__name__
+
+
+class Metric(Capsule):
+    """Abstract accumulator: override ``launch`` and ``reset``
+    (``meter.py:98-111``)."""
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__}: implement launch(attrs) to accumulate."
+        )
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__}: implement reset(attrs) to finalize/clear."
+        )

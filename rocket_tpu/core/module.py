@@ -1,0 +1,451 @@
+"""Module capsule — wraps a model; compiles the fused TPU train/eval step.
+
+Reference semantics (``rocket/core/module.py``):
+
+* children are the post-forward pipeline — Loss / Optimizer / Scheduler
+  (``module.py:16-18``) — and the forward *replaces the batch*:
+  ``attrs.batch = module.forward(attrs.batch)`` (``module.py:73``);
+* prepared exactly once per raw model with identity-dedup (``module.py:29-43``),
+  so one model shared by train and eval capsules has one set of variables;
+* train/eval switched off the ambient grad mode (``module.py:62-68``) — here
+  off the explicit ``attrs.mode`` set by the Looper;
+* gradient accumulation wraps the forward (``module.py:71``).
+
+TPU substrate (SURVEY.md §7 design stance): per-iteration array work —
+forward, loss, backward, optimizer update, gradient accumulation and the
+data-parallel gradient mean — cannot stay as N eager capsule bodies; it is
+compiled here into ONE jitted, donated-argument ``train_step(state, batch) ->
+(state, metrics)``. The Loss/Optimizer/Scheduler capsules contribute their
+pieces at setup time (objective, optax factory, lr schedule) and keep their
+host-side roles (logging, checkpoint state) at launch time. The cross-replica
+gradient mean needs no explicit collective: the loss is a mean over the
+*global* (mesh-sharded) batch, and XLA GSPMD lowers the backward reduction to
+ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rocket_tpu import optim as optim_lib
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+
+__all__ = ["Module", "PreparedModule"]
+
+
+def _tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _to_plain(tree):
+    """Normalize Attributes bags to plain dicts so the step fn sees one
+    container type regardless of how the bag auto-wrapped nested dicts."""
+    from rocket_tpu.core.attributes import Attributes
+
+    if isinstance(tree, (dict, Attributes)):
+        return {k: _to_plain(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return tuple(_to_plain(v) for v in tree)
+    if isinstance(tree, list):
+        return [_to_plain(v) for v in tree]
+    return tree
+
+
+def _split_batch(batch):
+    """Split a batch pytree into (jit-traceable, static) halves.
+
+    Rocket collate lets strings/tuples pass through uncollated
+    (``utils.py:19-27``); those leaves cannot enter jit, so they ride around
+    the compiled step and are merged back into the output batch.
+    """
+    batch = _to_plain(batch)
+    is_arr = lambda leaf: isinstance(leaf, (jax.Array, np.ndarray))
+    dynamic = jax.tree.map(lambda l: l if is_arr(l) else None, batch)
+    static = jax.tree.map(lambda l: None if is_arr(l) else l, batch)
+    return dynamic, static
+
+
+def _merge_batch(dynamic, static):
+    """Overlay the static (non-array) leaves back onto the step output.
+
+    The output structure may differ from the input (the forward adds keys —
+    e.g. ``logits``), so this is a recursive union, not a tree.map: dynamic
+    values win, static fills the holes.
+    """
+    if static is None:
+        return dynamic
+    if dynamic is None:
+        return static
+    if isinstance(dynamic, dict) and isinstance(static, dict):
+        out = {}
+        for key in {**static, **dynamic}:
+            out[key] = _merge_batch(dynamic.get(key), static.get(key))
+        return out
+    if isinstance(dynamic, (list, tuple)) and isinstance(static, (list, tuple)):
+        merged = [
+            _merge_batch(d, s)
+            for d, s in zip(dynamic, static)
+        ]
+        merged += list(dynamic[len(static):]) + list(static[len(dynamic):])
+        return type(dynamic)(merged) if isinstance(dynamic, tuple) else merged
+    return dynamic
+
+
+class PreparedModule:
+    """The shared prepared record for one raw model (reference
+    ``Accelerator._models`` entry): its live variables plus step bookkeeping.
+    Mutable on purpose — train and eval capsules wrapping the same model see
+    the same state."""
+
+    def __init__(self, model, state: dict) -> None:
+        self.model = model
+        self.state = state  # {"params", "model_state", "opt_state", "step", "base_key", ...}
+        # Which layout the state carries: None (not yet placed), "default"
+        # (replicated), or "rule" (an explicit param_sharding was applied).
+        self.placed_by: Optional[str] = None
+
+
+class Module(Dispatcher):
+    """Capsule wrapping a :class:`rocket_tpu.nn.Model`.
+
+    Parameters
+    ----------
+    model:
+        Object with ``init(key) -> variables`` and
+        ``apply(variables, batch, *, mode, rng) -> (batch, new_state)``.
+    capsules:
+        Post-forward pipeline — ``Loss`` / ``Optimizer`` / ``Scheduler``
+        (train) or empty (eval).
+    compute_dtype:
+        When set (e.g. ``jnp.bfloat16``), float batch inputs are cast to this
+        dtype before the forward; params stay float32 master copies (layers
+        cast at use).
+    remat:
+        Apply ``jax.checkpoint`` to the forward to trade FLOPs for HBM.
+    param_sharding:
+        Optional fn ``(path_tuple, leaf) -> PartitionSpec`` for sharded params
+        (tensor parallelism / fsdp); default fully replicated.
+    return_outputs:
+        ``"eval"`` (default): the transformed batch is materialized only in
+        eval mode — train returns just metrics, keeping activations out of
+        HBM round-trips. ``"always"`` / ``"never"`` override.
+    """
+
+    def __init__(
+        self,
+        model,
+        capsules=(),
+        compute_dtype=None,
+        remat: bool = False,
+        param_sharding: Optional[Callable] = None,
+        return_outputs: str = "eval",
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(capsules, statefull=statefull, priority=priority, runtime=runtime)
+        self._model = model
+        self._compute_dtype = compute_dtype
+        self._remat = remat
+        self._param_sharding = param_sharding
+        self._return_outputs = return_outputs
+        self._prepared: Optional[PreparedModule] = None
+        self._train_step = None
+        self._eval_step = None
+        self._host_step: Optional[int] = None
+
+    # -- introspection helpers ---------------------------------------------
+
+    @property
+    def prepared(self) -> Optional[PreparedModule]:
+        return self._prepared
+
+    @property
+    def state(self) -> Optional[dict]:
+        return None if self._prepared is None else self._prepared.state
+
+    def _find_contrib(self):
+        """Collect compiled-step contributions from children."""
+        from rocket_tpu.core.loss import Loss
+        from rocket_tpu.core.optimizer import Optimizer
+        from rocket_tpu.core.scheduler import Scheduler
+
+        losses = self.find(Loss)
+        optimizers = self.find(Optimizer)
+        schedulers = self.find(Scheduler)
+        if len(losses) > 1 or len(optimizers) > 1 or len(schedulers) > 1:
+            raise RuntimeError(
+                "Module: at most one Loss, Optimizer and Scheduler per Module."
+            )
+        objective = losses[0].objective if losses else None
+        opt = optimizers[0].opt if optimizers else None
+        schedule = schedulers[0].schedule if schedulers else None
+        base_lr = optimizers[0].learning_rate if optimizers else None
+        return objective, opt, schedule, base_lr
+
+    # -- events ------------------------------------------------------------
+
+    def setup(self, attrs: Attributes | None = None) -> None:
+        super().setup(attrs)  # children first register their own state
+        runtime = self._runtime
+
+        prepared = runtime.models.lookup(self._model)
+        if prepared is None:
+            variables = self._model.init(runtime.next_key())
+            state = {
+                "params": variables["params"],
+                "model_state": variables.get("state", {}),
+                "step": jnp.zeros((), jnp.int32),
+                "base_key": jax.random.key_data(runtime.next_key()),
+            }
+            prepared = PreparedModule(self._model, state)
+            runtime.models.add(self._model, prepared)
+        self._prepared = prepared
+
+        objective, opt, schedule, base_lr = self._find_contrib()
+        if opt is not None:
+            if objective is None:
+                raise RuntimeError("Module: an Optimizer child requires a Loss child.")
+            lr = schedule if schedule is not None else (base_lr if base_lr is not None else 1e-3)
+            tx = optim_lib.resolve(opt, lr)
+            if "opt_state" not in prepared.state:
+                prepared.state["opt_state"] = tx.init(prepared.state["params"])
+                if runtime.gradient_accumulation_steps > 1:
+                    prepared.state["grad_accum"] = _tree_zeros_like(
+                        prepared.state["params"]
+                    )
+                    # Running loss over the accumulation window, kept in-step
+                    # so the Loss capsule never issues eager device ops.
+                    prepared.state["loss_acc"] = jnp.zeros((), jnp.float32)
+            self._lr_fn = lr if callable(lr) else (lambda step: jnp.asarray(lr))
+            self._build_train_step(objective, tx)
+        elif objective is not None:
+            raise RuntimeError("Module: a Loss child requires an Optimizer child.")
+
+        # Lay the state out on the mesh: replicated by default, or per the
+        # param_sharding rule (tensor parallel / fsdp). Placement happens
+        # ONCE per prepared model — a second capsule wrapping the same model
+        # (e.g. the eval Module) must not clobber the layout the first one
+        # installed. An explicit rule upgrades a default placement; two
+        # different explicit rules are an error.
+        if self._param_sharding is not None:
+            if prepared.placed_by == "rule":
+                raise RuntimeError(
+                    "Module: model already placed by another capsule's "
+                    "param_sharding rule; only one rule per model."
+                )
+            prepared.state = self._place_state(prepared.state)
+            prepared.placed_by = "rule"
+        elif prepared.placed_by is None:
+            prepared.state = self._place_state(prepared.state)
+            prepared.placed_by = "default"
+        self._build_eval_step()
+
+    def _place_state(self, state: dict) -> dict:
+        runtime = self._runtime
+        if self._param_sharding is None:
+            return jax.device_put(state, runtime.replicated)
+
+        def place(path, leaf):
+            # Normalize jax key-path entries to plain strings ('0', 'w', ...).
+            names = tuple(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+            )
+            spec = self._param_sharding(names, leaf)
+            sharding = runtime.replicated if spec is None else runtime.sharding(*spec)
+            return jax.device_put(leaf, sharding)
+
+        out = {
+            key: jax.device_put(value, runtime.replicated)
+            for key, value in state.items()
+            if key not in ("params", "grad_accum")
+        }
+        out["params"] = jax.tree_util.tree_map_with_path(place, state["params"])
+        if "grad_accum" in state:
+            # Accumulator mirrors the param layout.
+            out["grad_accum"] = jax.tree_util.tree_map_with_path(
+                place, state["grad_accum"]
+            )
+        return out
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _forward(self):
+        model = self._model
+        compute_dtype = self._compute_dtype
+
+        def forward(params, model_state, batch, *, mode, rng):
+            if compute_dtype is not None:
+                batch = jax.tree.map(
+                    lambda l: l.astype(compute_dtype)
+                    if isinstance(l, jax.Array) and jnp.issubdtype(l.dtype, jnp.floating)
+                    else l,
+                    batch,
+                )
+            variables = {"params": params, "state": model_state}
+            return model.apply(variables, batch, mode=mode, rng=rng)
+
+        if self._remat:
+            forward = jax.checkpoint(forward, static_argnums=())  # noqa: A001
+        return forward
+
+    def _build_train_step(self, objective, tx) -> None:
+        runtime = self._runtime
+        accum = runtime.gradient_accumulation_steps
+        forward = self._forward()
+        lr_fn = self._lr_fn
+        return_out = self._return_outputs == "always"
+
+        def train_step(state, batch):
+            rng = jax.random.fold_in(
+                jax.random.wrap_key_data(state["base_key"]), state["step"]
+            )
+
+            def loss_fn(params):
+                out, mstate = forward(
+                    params, state["model_state"], batch, mode="train", rng=rng
+                )
+                loss = objective(out)
+                return loss.astype(jnp.float32), (out, mstate)
+
+            (loss, (out, mstate)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"])
+
+            new_state = dict(state)
+            new_state["model_state"] = mstate
+            new_state["step"] = state["step"] + 1
+
+            if accum == 1:
+                updates, opt_state = tx.update(
+                    grads, state["opt_state"], state["params"]
+                )
+                new_state["params"] = optax.apply_updates(state["params"], updates)
+                new_state["opt_state"] = opt_state
+                opt_step = state["step"]
+            else:
+                # The accumulation phase is DERIVED from the step counter —
+                # host and device compute the same boundary from the same
+                # number, so there is no second counter to drift across
+                # epochs or resumes.
+                acc = jax.tree.map(jnp.add, state["grad_accum"], grads)
+                is_boundary = (state["step"] + 1) % accum == 0
+                opt_step = state["step"] // accum
+
+                def apply_update(operand):
+                    acc, params, opt_state = operand
+                    mean_grads = jax.tree.map(lambda g: g / accum, acc)
+                    updates, opt_state = tx.update(mean_grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return _tree_zeros_like(acc), params, opt_state
+
+                def hold(operand):
+                    return operand
+
+                acc, params, opt_state = jax.lax.cond(
+                    is_boundary,
+                    apply_update,
+                    hold,
+                    (acc, state["params"], state["opt_state"]),
+                )
+                new_state["grad_accum"] = acc
+                new_state["params"] = params
+                new_state["opt_state"] = opt_state
+
+            if accum == 1:
+                loss_window = loss
+            else:
+                loss_acc = state["loss_acc"] + loss / accum
+                loss_window = jnp.where(is_boundary, loss_acc, 0.0)
+                new_state["loss_acc"] = jnp.where(is_boundary, 0.0, loss_acc)
+
+            metrics = {
+                "loss": loss,
+                # Mean loss over the just-closed accumulation window; only
+                # meaningful on the sync boundary.
+                "loss_window": loss_window,
+                "lr": jnp.asarray(lr_fn(opt_step), jnp.float32),
+            }
+            if return_out:
+                metrics["outputs"] = out
+            return new_state, metrics
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+
+    def _build_eval_step(self) -> None:
+        forward = self._forward()
+
+        def eval_step(params, model_state, batch):
+            out, _ = forward(params, model_state, batch, mode="eval", rng=None)
+            return out
+
+        self._eval_step = jax.jit(eval_step)
+
+    # -- launch ------------------------------------------------------------
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.batch is None:
+            return  # no batch -> skip (module.py:59-60)
+
+        dynamic, static = _split_batch(attrs.batch)
+        state = self._prepared.state
+
+        if attrs.mode == "train":
+            if self._train_step is None:
+                raise RuntimeError(
+                    "Module: train launch without Loss/Optimizer children — "
+                    "give this Module its post-forward pipeline or run it in "
+                    "an eval Looper."
+                )
+            # Mirror the device-side step counter once (a single host sync at
+            # the first step / after a resume); afterwards host and device
+            # derive the sync boundary from the same number.
+            if self._host_step is None:
+                self._host_step = int(np.asarray(state["step"]))
+            new_state, metrics = self._train_step(state, dynamic)
+            self._prepared.state = new_state
+            self._host_step += 1
+            accum = self._runtime.gradient_accumulation_steps
+            attrs.sync_gradients = (self._host_step % accum) == 0
+            outputs = metrics.pop("outputs", None)
+            attrs.step_metrics = Attributes(metrics)
+            if outputs is not None:
+                attrs.batch = _merge_batch(outputs, static)
+        else:
+            out = self._eval_step(state["params"], state["model_state"], dynamic)
+            attrs.batch = _merge_batch(out, static)  # forward replaces batch
+            attrs.step_metrics = None
+            attrs.sync_gradients = None
+
+        # Post-forward pipeline: Loss/Optimizer/Scheduler log host-side.
+        Dispatcher.launch(self, attrs)
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        # NOTE: the host step mirror is NOT reset — accumulation windows are
+        # step-aligned and may span epoch boundaries, exactly like the
+        # device-side counter they mirror.
+        super().reset(attrs)
+
+    def destroy(self, attrs: Attributes | None = None) -> None:
+        if self._prepared is not None and self._runtime is not None:
+            self._runtime.models.remove(self._model)  # fixes dataset.py:129-142 class of bug
+        self._prepared = None
+        super().destroy(attrs)
+
+    def __repr__(self) -> str:
+        head = f"Module({type(self._model).__name__})"
+        if not self._capsules:
+            return head
+        lines = [head + "("]
+        for capsule in self._capsules:
+            body = repr(capsule)
+            lines.append("\n".join("    " + l for l in body.splitlines()) + ",")
+        lines.append(")")
+        return "\n".join(lines)

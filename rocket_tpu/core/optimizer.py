@@ -1,0 +1,76 @@
+"""Optimizer capsule — contributes the update rule to the compiled step.
+
+Reference semantics (``rocket/core/optimizer.py``): wraps a torch optimizer,
+prepared once with identity-dedup (``optimizer.py:21-41``); ``launch`` steps
+and zeroes grads when training (``optimizer.py:46-48``); on the sync boundary
+logs per-group lr into ``attrs.tracker.scalars`` / ``attrs.looper.state.lr``
+and bumps an iteration counter (``optimizer.py:50-63``).
+
+TPU substrate: the update rule is an ``optax.GradientTransformation`` (or a
+factory ``fn(lr) -> tx`` so a Scheduler can inject its schedule) compiled into
+the Module's jitted step — ``step(); zero_grad()`` has no host-side
+equivalent. The optimizer state lives in the module's TrainState and is
+checkpointed with it; this capsule keeps the host-side roles: lr logging and
+the update counter.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import optax
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer(Capsule):
+    def __init__(
+        self,
+        opt: Union[optax.GradientTransformation, "callable"],
+        learning_rate: Optional[float] = None,
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        self._opt = opt
+        self._learning_rate = learning_rate
+        self._iter_idx = 0
+
+    @property
+    def opt(self):
+        return self._opt
+
+    @property
+    def learning_rate(self) -> Optional[float]:
+        return self._learning_rate
+
+    @property
+    def iter_idx(self) -> int:
+        return self._iter_idx
+
+    # -- events ------------------------------------------------------------
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.mode != "train":
+            return  # train-only (optimizer.py:46)
+        if not attrs.sync_gradients:
+            return
+        # Boundary bookkeeping (optimizer.py:50-63).
+        self._iter_idx += 1
+        if attrs.step_metrics is not None and attrs.step_metrics.lr is not None:
+            if attrs.tracker is not None:
+                attrs.tracker.scalars["lr"] = attrs.step_metrics.lr
+            if attrs.looper is not None:
+                attrs.looper.state.lr = attrs.step_metrics.lr
+
+    # -- checkpoint state (optimizer.py:81-85 — here actually wired) -------
+
+    def state_dict(self) -> dict:
+        return {"iter_idx": self._iter_idx}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._iter_idx = int(state["iter_idx"])

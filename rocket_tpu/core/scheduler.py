@@ -1,0 +1,44 @@
+"""Scheduler capsule — contributes the lr schedule to the compiled step.
+
+Reference semantics (``rocket/core/scheduler.py``): wraps a torch LR
+scheduler, prepared once with dedup (``scheduler.py:18-38``); ``launch`` steps
+it when training (``scheduler.py:40-43``); stateless.
+
+TPU substrate: the schedule is a pure ``step -> lr`` function (any optax
+schedule works) baked into the optimizer transformation at Module setup, so
+the per-iteration ``scheduler.step()`` is compiled away — optax tracks the
+update count inside the optimizer state, which is checkpointed with the
+TrainState. The capsule remains for composition parity and introspection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+__all__ = ["Scheduler"]
+
+
+class Scheduler(Capsule):
+    def __init__(
+        self,
+        schedule: Callable[[int], float],
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        if not callable(schedule):
+            raise TypeError("Scheduler: schedule must be callable (step -> lr).")
+        self._schedule = schedule
+
+    @property
+    def schedule(self) -> Callable[[int], float]:
+        return self._schedule
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        # The schedule advances inside the compiled step (scheduler.py:40-43
+        # has no host-side equivalent).
+        pass

@@ -1,0 +1,179 @@
+"""Tracker capsule — experiment logging with pluggable backends.
+
+Reference semantics (``rocket/core/tracker.py``):
+
+* priority 200 (``tracker.py:19``); default backend "tensorboard"
+  (``tracker.py:13``) with a registry keyed by name (``tracker.py:30-46``);
+* ``set()`` creates per-epoch buffers ``attrs.tracker = {scalars, images}``
+  (``tracker.py:50-53``);
+* ``launch()`` flushes only on the gradient-sync boundary during training
+  (``tracker.py:62-65``); eval flushes every launch; images are logged when
+  the backend supports it (``tracker.py:90-101``); after a flush the buffers
+  reset and the tracker's own ``iter_idx`` is the global step
+  (``tracker.py:105-117``); stateful ``iter_idx`` (``tracker.py:79-83``).
+
+TPU note: capsules publish *device scalars* into the buffers (no per-iteration
+host sync); the float() conversion happens here at flush time, amortized over
+``flush_every`` boundaries. Backends: ``jsonl`` (always available) and
+``tensorboard`` (when importable); only the main process writes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import PRIORITY_TRACKER, Capsule
+
+__all__ = ["Tracker", "JsonlBackend", "TensorBoardBackend"]
+
+
+class JsonlBackend:
+    """One JSON object per flush, appended to ``<dir>/<project>.jsonl``."""
+
+    def __init__(self, project: str, directory: str = "runs") -> None:
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, f"{project}.jsonl")
+        self._file = open(self._path, "a", buffering=1)
+
+    def log_scalars(self, scalars: dict, step: int) -> None:
+        record = {"step": step, "time": time.time(), **scalars}
+        self._file.write(json.dumps(record) + "\n")
+
+    def log_images(self, images: dict, step: int) -> None:
+        pass  # not representable in jsonl
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class TensorBoardBackend:
+    def __init__(self, project: str, directory: str = "runs") -> None:
+        from torch.utils.tensorboard import SummaryWriter  # torch is baked in
+
+        self._writer = SummaryWriter(os.path.join(directory, project))
+
+    def log_scalars(self, scalars: dict, step: int) -> None:
+        for key, value in scalars.items():
+            self._writer.add_scalar(key, value, step)
+
+    def log_images(self, images: dict, step: int) -> None:
+        for key, value in images.items():
+            self._writer.add_image(key, np.asarray(value), step, dataformats="HWC")
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+_BACKENDS = {"jsonl": JsonlBackend, "tensorboard": TensorBoardBackend}
+
+
+class Tracker(Capsule):
+    def __init__(
+        self,
+        backend: str = "jsonl",
+        project: str = "rocket",
+        config: Optional[dict] = None,
+        directory: str = "runs",
+        statefull: bool = True,
+        priority: int = PRIORITY_TRACKER,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        self._backend_name = backend
+        self._project = project
+        self._config = config or {}
+        self._directory = directory
+        self._backend = None
+        self._iter_idx = 0
+
+    # -- events ------------------------------------------------------------
+
+    def setup(self, attrs: Attributes | None = None) -> None:
+        super().setup(attrs)
+        runtime = self._runtime
+        # Registry with lazy init (tracker.py:30-46).
+        backend = runtime.get_tracker(self._backend_name)
+        if backend is None and runtime.is_main_process:
+            factory = _BACKENDS.get(self._backend_name)
+            if factory is None:
+                raise RuntimeError(
+                    f"Tracker: unknown backend {self._backend_name!r}; "
+                    f"available: {sorted(_BACKENDS)}"
+                )
+            try:
+                backend = factory(self._project, self._directory)
+            except ImportError:
+                self.log_warning(
+                    f"backend {self._backend_name!r} unavailable, "
+                    "falling back to jsonl"
+                )
+                backend = JsonlBackend(self._project, self._directory)
+            runtime.init_tracker(self._backend_name, backend)
+            if self._config:
+                backend.log_scalars(
+                    {f"config/{k}": v for k, v in self._config.items()
+                     if isinstance(v, (int, float))},
+                    step=0,
+                )
+        self._backend = backend
+
+    def set(self, attrs: Attributes | None = None) -> None:
+        super().set(attrs)
+        if attrs is not None:
+            # Per-epoch buffers (tracker.py:50-53).
+            attrs.tracker = Attributes(scalars=Attributes(), images=Attributes())
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.tracker is None:
+            return
+        if attrs.mode == "train" and not attrs.sync_gradients:
+            return  # flush only on the sync boundary in training (tracker.py:62-65)
+        self._flush(attrs)
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        if attrs is not None and attrs.tracker is not None:
+            self._flush(attrs)  # drain remaining buffered values at epoch end
+            attrs.tracker = None
+        super().reset(attrs)
+
+    # -- flush -------------------------------------------------------------
+
+    def _flush(self, attrs: Attributes) -> None:
+        scalars = attrs.tracker.scalars or {}
+        images = attrs.tracker.images or {}
+        if not scalars and not images:
+            return
+        tag = None
+        if attrs.looper is not None:
+            tag = attrs.looper.tag
+        if self._backend is not None:
+            if scalars:
+                host_scalars = {
+                    (f"{tag}/{k}" if tag else k): float(np.asarray(v))
+                    for k, v in scalars.items()
+                }
+                self._backend.log_scalars(host_scalars, self._iter_idx)
+            if images:
+                host_images = {
+                    (f"{tag}/{k}" if tag else k): np.asarray(v)
+                    for k, v in images.items()
+                }
+                self._backend.log_images(host_images, self._iter_idx)
+        # Reset buffers, bump the global step (tracker.py:114-117).
+        attrs.tracker.scalars = Attributes()
+        attrs.tracker.images = Attributes()
+        self._iter_idx += 1
+
+    # -- checkpoint state --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"iter_idx": self._iter_idx}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._iter_idx = int(state["iter_idx"])

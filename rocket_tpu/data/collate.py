@@ -1,0 +1,112 @@
+"""Structure-preserving collate / move — the framework's host-side pytree ops.
+
+Reference semantics (``rocket/utils.py:16-97``, verified in SURVEY.md §2a):
+
+* ``default_collate``: array samples **stack** along a new leading batch axis;
+  ``str`` / ``float`` / ``int`` / ``tuple`` samples **pass through uncollated**
+  (the batch stays a list); ``Mapping`` and ``list`` samples collate
+  per-element recursively, preserving the container type.
+* ``default_move``: recursive, type-preserving device transfer — arrays move,
+  scalars/strings are identity.
+
+Here the array type is ``numpy`` on the host (TPU placement happens later via
+``Runtime.shard_batch`` — a *sharding*, not a single-device copy).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["default_collate", "default_move"]
+
+# Types that pass through collate uncollated (utils.py:19-27).
+_PASSTHROUGH = (str, bytes, tuple, int, float, bool, type(None))
+
+
+def default_collate(samples: Sequence[Any]) -> Any:
+    """Collate a list of samples into one batch, rocket-style.
+
+    >>> default_collate([np.zeros((2,)), np.ones((2,))]).shape
+    (2, 2)
+    >>> default_collate(["a", "b"])       # strings pass through
+    ['a', 'b']
+    >>> default_collate([{"x": np.zeros(2)}, {"x": np.ones(2)}])["x"].shape
+    (2, 2)
+    """
+    if len(samples) == 0:
+        raise ValueError("default_collate: empty sample list")
+    first = samples[0]
+
+    if isinstance(first, (np.ndarray, jax.Array)):
+        return np.stack([np.asarray(s) for s in samples])
+    if isinstance(first, _PASSTHROUGH):
+        # Uncollated pass-through, including tuples (utils.py:19-27 — the
+        # reference's fn-map returns these batches unchanged).
+        return list(samples)
+    if isinstance(first, Mapping):
+        out = {key: default_collate([s[key] for s in samples]) for key in first}
+        try:
+            return type(first)(out)
+        except TypeError:
+            return out
+    if isinstance(first, Sequence):
+        transposed = [default_collate(list(group)) for group in zip(*samples)]
+        try:
+            return type(first)(transposed)
+        except TypeError:
+            return transposed
+    if hasattr(first, "__array__"):
+        return np.stack([np.asarray(s) for s in samples])
+    # Unknown leaf type: pass through as-is.
+    return list(samples)
+
+
+def default_move(
+    tree: Any,
+    placement: Optional[Any] = None,
+    move_fn: Optional[Callable[[Any], Any]] = None,
+) -> Any:
+    """Recursively move array leaves, preserving container structure.
+
+    ``placement`` may be a ``jax.Device`` or a ``Sharding``; alternatively pass
+    an explicit ``move_fn``. Non-array leaves (str, int, ...) are identity,
+    mirroring ``utils.py:40-97``.
+    """
+    if move_fn is None:
+        if placement is None:
+            raise ValueError("default_move: need placement or move_fn")
+
+        def move_fn(leaf):
+            return jax.device_put(leaf, placement)
+
+    def visit(node: Any) -> Any:
+        if isinstance(node, (np.ndarray, jax.Array)):
+            return move_fn(node)
+        if isinstance(node, (str, bytes, int, float, bool, type(None))):
+            return node
+        if isinstance(node, Mapping):
+            out = {k: visit(v) for k, v in node.items()}
+            try:
+                return type(node)(out)
+            except TypeError:
+                return out
+        if isinstance(node, tuple):
+            values = [visit(v) for v in node]
+            if hasattr(node, "_fields"):  # namedtuple
+                return type(node)(*values)
+            return tuple(values)
+        if isinstance(node, Sequence):
+            values = [visit(v) for v in node]
+            try:
+                return type(node)(values)
+            except TypeError:
+                return values
+        if hasattr(node, "__array__"):
+            return move_fn(np.asarray(node))
+        return node
+
+    return visit(tree)

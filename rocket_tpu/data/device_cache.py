@@ -1,0 +1,164 @@
+"""Device-resident dataset cache — zero per-step host-to-device traffic.
+
+The reference streams every batch host->device per iteration
+(``dataset.py:111-118``); on TPU that H2D hop is the throughput killer for
+small/medium datasets (measured here: ~7.5 ms/MB through the host tunnel vs
+0.04 ms for an on-device gather of the same batch). For datasets that fit in
+HBM, the idiomatic layout is:
+
+* upload the whole collated dataset ONCE at setup;
+* upload the epoch's shuffle permutation ONCE per epoch (wrap-padded so every
+  batch is full);
+* per step, run a tiny jitted ``(cache, perm, counter) -> (batch, counter+1)``
+  gather whose counter *lives on device* — the steady-state loop moves no
+  bytes between host and chip, and the output batch is laid out with the
+  mesh's data-axis sharding so it feeds the train step directly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu.data.loader import Batch
+
+__all__ = ["DeviceCachedLoader", "pytree_nbytes"]
+
+
+def pytree_nbytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+class DeviceCachedLoader:
+    """Drop-in for ``DataLoader`` over an in-memory collated pytree.
+
+    Parameters
+    ----------
+    data:
+        Collated pytree of host numpy arrays, leading dim = num samples.
+    batch_size:
+        Global batch size.
+    runtime:
+        The runtime (mesh + batch sharding + seed).
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        batch_size: int,
+        runtime,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+    ) -> None:
+        leaves = jax.tree.leaves(data)
+        if not leaves:
+            raise ValueError("DeviceCachedLoader: empty dataset pytree")
+        self._n = int(leaves[0].shape[0])
+        for leaf in leaves:
+            if leaf.shape[0] != self._n:
+                raise ValueError(
+                    "DeviceCachedLoader: inconsistent leading dimensions"
+                )
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self._runtime = runtime
+        self._epoch = 0
+        self._skip = 0
+
+        # One-time upload, replicated: every device can gather any row, and
+        # the gather output is re-laid-out to the data-axis sharding below.
+        # Already-on-device data (a cache shared by another loader over the
+        # same dataset) is used as-is.
+        if all(isinstance(l, jax.Array) for l in leaves):
+            self._cache = data
+        else:
+            self._cache = jax.device_put(data, runtime.replicated)
+
+        batch_sharding = runtime.batch_sharding
+        replicated = runtime.replicated
+
+        def gather(cache, perm, counter):
+            start = counter * batch_size
+            idx = jax.lax.dynamic_slice_in_dim(perm, start, batch_size)
+            batch = jax.tree.map(
+                lambda leaf: jax.lax.with_sharding_constraint(
+                    jnp.take(leaf, idx, axis=0), batch_sharding
+                ),
+                cache,
+            )
+            return batch, counter + 1
+
+        self._gather = jax.jit(
+            gather,
+            out_shardings=(None, replicated),
+        )
+        self._counter = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+        self._perm = None
+
+    @property
+    def cache(self):
+        """The device-resident dataset pytree (sharable across loaders)."""
+        return self._cache
+
+    # -- sizing ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self._n // self.batch_size
+        return (self._n + self.batch_size - 1) // self.batch_size
+
+    @property
+    def total(self) -> Optional[int]:
+        return len(self)
+
+    # -- epoch / resume control -------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    def skip(self, num_batches: int) -> None:
+        self._skip = int(num_batches)
+
+    # -- iteration ---------------------------------------------------------
+
+    def _make_perm(self) -> np.ndarray:
+        order = np.arange(self._n)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, self._epoch, 0x90C3E7])
+            )
+            rng.shuffle(order)
+        num_batches = len(self)
+        padded = num_batches * self.batch_size
+        if padded > self._n:
+            order = np.concatenate([order, order[: padded - self._n]])
+        else:
+            order = order[:padded]
+        return order.astype(np.int32)
+
+    def __iter__(self):
+        skip, self._skip = self._skip, 0
+        num_batches = len(self)
+        # One per-epoch upload: the permutation (tiny vs the data).
+        self._perm = jax.device_put(self._make_perm(), self._runtime.replicated)
+        counter = jax.device_put(
+            jnp.asarray(skip, jnp.int32), self._runtime.replicated
+        )
+        remainder = self._n - (num_batches - 1) * self.batch_size
+        for b in range(skip, num_batches):
+            data, counter = self._gather(self._cache, self._perm, counter)
+            real = self.batch_size
+            if not self.drop_last and b == num_batches - 1:
+                real = remainder
+            yield Batch(data, size=real, index=b)

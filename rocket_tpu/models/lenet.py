@@ -1,0 +1,48 @@
+"""LeNet-5 — the reference example's model (``examples/mnist.py:42-74``),
+rebuilt NHWC/TPU-native."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu import nn
+
+__all__ = ["LeNet"]
+
+
+class LeNet(nn.Model):
+    def __init__(
+        self,
+        num_classes: int = 10,
+        image_key: str = "image",
+        logits_key: str = "logits",
+    ):
+        self.trunk = nn.Sequential(
+            nn.Conv2D(1, 6, kernel_size=5, padding="SAME"),
+            nn.relu(),
+            nn.MaxPool2D(2),
+            nn.Conv2D(6, 16, kernel_size=5, padding="VALID"),
+            nn.relu(),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(16 * 5 * 5, 120),
+            nn.relu(),
+            nn.Dense(120, 84),
+            nn.relu(),
+            nn.Dense(84, num_classes),
+        )
+        self.image_key = image_key
+        self.logits_key = logits_key
+
+    def init(self, key: jax.Array) -> nn.Variables:
+        return self.trunk.init(key)
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        x = batch[self.image_key]
+        if x.ndim == 3:
+            x = x[..., None]  # (B, H, W) -> (B, H, W, C=1), NHWC
+        logits, new_state = self.trunk.apply(variables, x, mode=mode, rng=rng)
+        out = dict(batch)
+        out[self.logits_key] = logits
+        return out, new_state

@@ -1,0 +1,49 @@
+"""MLP classifier — the minimum end-to-end model (SURVEY §7 stage 4).
+
+Batch contract (the reference's forward-replaces-batch dataflow,
+``module.py:73``): reads ``batch[image_key]``, writes ``batch[logits_key]``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from rocket_tpu import nn
+
+__all__ = ["MLP"]
+
+
+class MLP(nn.Model):
+    def __init__(
+        self,
+        in_features: int,
+        num_classes: int,
+        hidden: Sequence[int] = (512, 256),
+        dropout: float = 0.0,
+        image_key: str = "image",
+        logits_key: str = "logits",
+    ):
+        layers = [nn.Flatten()]
+        prev = in_features
+        for width in hidden:
+            layers += [nn.Dense(prev, width), nn.relu()]
+            if dropout:
+                layers.append(nn.Dropout(dropout))
+            prev = width
+        layers.append(nn.Dense(prev, num_classes))
+        self.trunk = nn.Sequential(*layers)
+        self.image_key = image_key
+        self.logits_key = logits_key
+
+    def init(self, key: jax.Array) -> nn.Variables:
+        return self.trunk.init(key)
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        logits, new_state = self.trunk.apply(
+            variables, batch[self.image_key], mode=mode, rng=rng
+        )
+        out = dict(batch)
+        out[self.logits_key] = logits
+        return out, new_state

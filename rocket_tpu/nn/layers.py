@@ -1,0 +1,302 @@
+"""Core layers: dense, conv (NHWC), norms, pooling, embedding, dropout.
+
+All convolutional layers use **NHWC** layout with **HWIO** kernels — the
+native TPU layout (channels on the 128-wide lane dimension feeds the MXU
+without transposes). Matmul-heavy layers default their compute to the caller's
+dtype; params are stored in float32 and cast at use (master-weight mixed
+precision when the activations are bfloat16).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu.nn.module import Layer, Lambda
+
+__all__ = [
+    "Dense",
+    "Conv2D",
+    "MaxPool2D",
+    "AvgPool2D",
+    "GlobalAvgPool2D",
+    "BatchNorm",
+    "LayerNorm",
+    "Embedding",
+    "Dropout",
+    "Flatten",
+    "relu",
+    "gelu",
+    "tanh",
+    "silu",
+    "softmax",
+]
+
+
+def _pair(v: Union[int, Sequence[int]]) -> tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (v[0], v[1])
+
+
+class Dense(Layer):
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        use_bias: bool = True,
+        kernel_init: Callable = jax.nn.initializers.lecun_normal(),
+    ):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+
+    def init_params(self, key):
+        params = {
+            "w": self.kernel_init(key, (self.in_features, self.out_features), jnp.float32)
+        }
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        w = p["w"].astype(x.dtype)
+        y = x @ w
+        if self.use_bias:
+            y = y + p["b"].astype(x.dtype)
+        return y, variables["state"]
+
+    def __repr__(self):
+        return f"Dense({self.in_features}->{self.out_features})"
+
+
+class Conv2D(Layer):
+    """NHWC convolution with HWIO kernel (TPU-native layout)."""
+
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: Union[int, Sequence[int]] = 3,
+        stride: Union[int, Sequence[int]] = 1,
+        padding: Union[str, int] = "SAME",
+        use_bias: bool = True,
+        kernel_init: Callable = jax.nn.initializers.he_normal(),
+    ):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        if isinstance(padding, int):
+            padding = [(padding, padding), (padding, padding)]
+        self.padding = padding
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+
+    def init_params(self, key):
+        kh, kw = self.kernel_size
+        shape = (kh, kw, self.in_channels, self.out_channels)
+        params = {"w": self.kernel_init(key, shape, jnp.float32)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_channels,), jnp.float32)
+        return params
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        y = jax.lax.conv_general_dilated(
+            x,
+            p["w"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + p["b"].astype(x.dtype)
+        return y, variables["state"]
+
+    def __repr__(self):
+        return (
+            f"Conv2D({self.in_channels}->{self.out_channels}, "
+            f"k={self.kernel_size}, s={self.stride})"
+        )
+
+
+class _Pool2D(Layer):
+    def __init__(self, window, stride=None, padding="VALID"):
+        self.window = _pair(window)
+        self.stride = _pair(stride if stride is not None else window)
+        self.padding = padding
+
+    def _reduce(self, x, init, op):
+        return jax.lax.reduce_window(
+            x,
+            init,
+            op,
+            window_dimensions=(1, *self.window, 1),
+            window_strides=(1, *self.stride, 1),
+            padding=self.padding,
+        )
+
+
+class MaxPool2D(_Pool2D):
+    def apply(self, variables, x, *, mode="train", rng=None):
+        # init must be a Python scalar: reduce_window's autodiff rule pattern
+        # -matches the (max, -inf) monoid and a traced init breaks it.
+        return self._reduce(x, -jnp.inf, jax.lax.max), variables["state"]
+
+
+class AvgPool2D(_Pool2D):
+    def apply(self, variables, x, *, mode="train", rng=None):
+        summed = self._reduce(x, 0.0, jax.lax.add)
+        denom = self.window[0] * self.window[1]
+        return (summed / denom).astype(x.dtype), variables["state"]
+
+
+class GlobalAvgPool2D(Layer):
+    def apply(self, variables, x, *, mode="train", rng=None):
+        return jnp.mean(x, axis=(1, 2)), variables["state"]
+
+
+class BatchNorm(Layer):
+    """Batch normalization over all but the last (channel) axis.
+
+    Under a data-sharded batch the reductions are over the *global* logical
+    batch — XLA GSPMD turns them into ICI collectives automatically, so this
+    is cross-replica (sync) batchnorm by construction.
+    """
+
+    def __init__(self, num_features: int, momentum: float = 0.9, eps: float = 1e-5):
+        self.num_features = num_features
+        self.momentum = momentum
+        self.eps = eps
+
+    def init_params(self, key):
+        return {
+            "scale": jnp.ones((self.num_features,), jnp.float32),
+            "bias": jnp.zeros((self.num_features,), jnp.float32),
+        }
+
+    def init_state(self):
+        return {
+            "mean": jnp.zeros((self.num_features,), jnp.float32),
+            "var": jnp.ones((self.num_features,), jnp.float32),
+        }
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p, s = variables["params"], variables["state"]
+        axes = tuple(range(x.ndim - 1))
+        if mode == "train":
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = self.momentum
+            new_state = {
+                "mean": m * s["mean"] + (1 - m) * mean,
+                "var": m * s["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = s["mean"], s["var"]
+            new_state = s
+        inv = jax.lax.rsqrt(var + self.eps) * p["scale"]
+        y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+        return y.astype(x.dtype), new_state
+
+    def __repr__(self):
+        return f"BatchNorm({self.num_features})"
+
+
+class LayerNorm(Layer):
+    def __init__(self, num_features: int, eps: float = 1e-5, use_bias: bool = True):
+        self.num_features = num_features
+        self.eps = eps
+        self.use_bias = use_bias
+
+    def init_params(self, key):
+        params = {"scale": jnp.ones((self.num_features,), jnp.float32)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.num_features,), jnp.float32)
+        return params
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps) * p["scale"]
+        if self.use_bias:
+            y = y + p["bias"]
+        return y.astype(x.dtype), variables["state"]
+
+    def __repr__(self):
+        return f"LayerNorm({self.num_features})"
+
+
+class Embedding(Layer):
+    def __init__(
+        self,
+        num_embeddings: int,
+        features: int,
+        embedding_init: Callable = jax.nn.initializers.normal(stddev=0.02),
+    ):
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.embedding_init = embedding_init
+
+    def init_params(self, key):
+        return {
+            "table": self.embedding_init(
+                key, (self.num_embeddings, self.features), jnp.float32
+            )
+        }
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        return jnp.take(variables["params"]["table"], x, axis=0), variables["state"]
+
+    def __repr__(self):
+        return f"Embedding({self.num_embeddings}, {self.features})"
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        if mode != "train" or self.rate == 0.0:
+            return x, variables["state"]
+        if rng is None:
+            raise ValueError("Dropout needs an rng in train mode")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), variables["state"]
+
+    def __repr__(self):
+        return f"Dropout({self.rate})"
+
+
+class Flatten(Layer):
+    def apply(self, variables, x, *, mode="train", rng=None):
+        return x.reshape(x.shape[0], -1), variables["state"]
+
+
+# Activation layer shorthands.
+def relu() -> Lambda:
+    return Lambda(jax.nn.relu, "relu")
+
+
+def gelu() -> Lambda:
+    return Lambda(jax.nn.gelu, "gelu")
+
+
+def tanh() -> Lambda:
+    return Lambda(jnp.tanh, "tanh")
+
+
+def silu() -> Lambda:
+    return Lambda(jax.nn.silu, "silu")
+
+
+def softmax() -> Lambda:
+    return Lambda(jax.nn.softmax, "softmax")

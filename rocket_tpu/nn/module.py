@@ -1,0 +1,137 @@
+"""The nn module protocol: pure-functional layers with explicit variables.
+
+The reference wraps ``torch.nn.Module`` objects whose params live *inside* the
+object and whose ``forward(batch)`` replaces the batch (``module.py:24,73``).
+On TPU the idiomatic shape is functional: a layer/model is a *description*;
+its variables are an explicit pytree threaded through ``apply``.
+
+Conventions:
+
+* ``variables = {"params": pytree, "state": pytree}`` — ``params`` receive
+  gradients; ``state`` is non-differentiable (batchnorm running stats).
+* ``apply(variables, x, *, mode="train"|"eval", rng=None) -> (y, new_state)``
+  — always returns the (possibly unchanged) state so composition is uniform.
+* A :class:`Model` applies to the whole **batch pytree** and returns a
+  transformed batch, preserving the reference's dataflow contract
+  (``attrs.batch = module.forward(attrs.batch)``, ``module.py:73``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Layer", "Sequential", "Lambda", "Model", "Variables", "merge_state"]
+
+Variables = Dict[str, Any]
+
+
+def _empty() -> Variables:
+    return {"params": {}, "state": {}}
+
+
+class Layer:
+    """Base layer: stateless by default; subclasses override the `_init_*`
+    hooks and :meth:`apply`."""
+
+    def init(self, key: jax.Array) -> Variables:
+        return {"params": self.init_params(key), "state": self.init_state()}
+
+    def init_params(self, key: jax.Array) -> Any:
+        return {}
+
+    def init_state(self) -> Any:
+        return {}
+
+    def apply(
+        self,
+        variables: Variables,
+        x: Any,
+        *,
+        mode: str = "train",
+        rng: Optional[jax.Array] = None,
+    ) -> tuple[Any, Any]:
+        raise NotImplementedError
+
+    def __call__(self, variables: Variables, x: Any, **kwargs) -> tuple[Any, Any]:
+        return self.apply(variables, x, **kwargs)
+
+    def __repr__(self) -> str:
+        return type(self).__name__
+
+
+class Lambda(Layer):
+    """Wrap a pure elementwise function (activations, reshapes) as a layer."""
+
+    def __init__(self, fn: Callable[[jax.Array], jax.Array], name: str = ""):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "fn")
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        return self.fn(x), variables["state"]
+
+    def __repr__(self) -> str:
+        return f"Lambda({self.name})"
+
+
+class Sequential(Layer):
+    """Compose layers; variables keyed by layer index as strings."""
+
+    def __init__(self, *layers: Layer):
+        self.layers: Sequence[Layer] = tuple(layers)
+
+    def init(self, key: jax.Array) -> Variables:
+        params, state = {}, {}
+        for i, layer in enumerate(self.layers):
+            sub = layer.init(jax.random.fold_in(key, i))
+            params[str(i)] = sub["params"]
+            state[str(i)] = sub["state"]
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        new_state = {}
+        for i, layer in enumerate(self.layers):
+            sub = {
+                "params": variables["params"][str(i)],
+                "state": variables["state"][str(i)],
+            }
+            sub_rng = None if rng is None else jax.random.fold_in(rng, i)
+            x, new_state[str(i)] = layer.apply(sub, x, mode=mode, rng=sub_rng)
+        return x, new_state
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(l) for l in self.layers)
+        return f"Sequential({inner})"
+
+
+class Model:
+    """Batch-level module: ``apply`` maps the whole batch pytree to a
+    transformed batch (the reference's forward-replaces-batch contract).
+
+    Subclasses define their layers in ``__init__`` and implement
+    :meth:`init` / :meth:`apply`. Most models wrap one ``Sequential`` trunk
+    plus field plumbing (read ``batch["image"]``, write ``batch["logits"]``).
+    """
+
+    def init(self, key: jax.Array) -> Variables:
+        raise NotImplementedError
+
+    def apply(
+        self,
+        variables: Variables,
+        batch: Any,
+        *,
+        mode: str = "train",
+        rng: Optional[jax.Array] = None,
+    ) -> tuple[Any, Any]:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return type(self).__name__
+
+
+def merge_state(variables: Variables, new_state: Any) -> Variables:
+    """Variables with ``state`` replaced — the functional 'mutation'."""
+    return {"params": variables["params"], "state": new_state}

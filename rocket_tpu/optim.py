@@ -1,0 +1,115 @@
+"""Optimizer factories and LR schedules for the capsule API.
+
+The reference wraps ``torch.optim.Optimizer`` and ``lr_scheduler.LRScheduler``
+objects (``optimizer.py:10``, ``scheduler.py:10``). The TPU substrate is
+functional: an optimizer is an ``optax.GradientTransformation`` compiled into
+the jitted train step, and a scheduler is a pure ``step -> lr`` function.
+
+Because the reference keeps Optimizer and Scheduler as *separate composable
+capsules*, optimizers here are **factories** ``fn(learning_rate) -> tx`` so a
+``Scheduler`` capsule can inject its schedule at compile time; passing a plain
+``optax.GradientTransformation`` also works when no scheduler is used.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import optax
+
+__all__ = [
+    "sgd",
+    "momentum",
+    "adam",
+    "adamw",
+    "step_lr",
+    "cosine_lr",
+    "warmup_cosine_lr",
+    "constant_lr",
+    "resolve",
+]
+
+Schedule = Callable[[int], float]
+Factory = Callable[[Union[float, Schedule]], optax.GradientTransformation]
+
+
+def sgd(weight_decay: float = 0.0) -> Factory:
+    def make(learning_rate):
+        if weight_decay:
+            return optax.chain(
+                optax.add_decayed_weights(weight_decay), optax.sgd(learning_rate)
+            )
+        return optax.sgd(learning_rate)
+
+    return make
+
+
+def momentum(beta: float = 0.9, nesterov: bool = False) -> Factory:
+    def make(learning_rate):
+        return optax.sgd(learning_rate, momentum=beta, nesterov=nesterov)
+
+    return make
+
+
+def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Factory:
+    def make(learning_rate):
+        return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+    return make
+
+
+def adamw(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.01
+) -> Factory:
+    def make(learning_rate):
+        return optax.adamw(
+            learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay
+        )
+
+    return make
+
+
+# -- schedules (step -> lr), torch-scheduler analogues ----------------------
+
+
+def constant_lr(value: float) -> Schedule:
+    return lambda step: value
+
+
+def step_lr(base_lr: float, step_size: int, gamma: float = 0.1) -> Schedule:
+    """torch ``StepLR`` analogue (used by the reference example,
+    ``examples/mnist.py:80``) — decay by ``gamma`` every ``step_size`` steps."""
+    return optax.exponential_decay(
+        init_value=base_lr,
+        transition_steps=step_size,
+        decay_rate=gamma,
+        staircase=True,
+    )
+
+
+def cosine_lr(base_lr: float, decay_steps: int, alpha: float = 0.0) -> Schedule:
+    return optax.cosine_decay_schedule(base_lr, decay_steps, alpha=alpha)
+
+
+def warmup_cosine_lr(
+    base_lr: float, warmup_steps: int, decay_steps: int, end_lr: float = 0.0
+) -> Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=base_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        end_value=end_lr,
+    )
+
+
+def resolve(opt, learning_rate) -> optax.GradientTransformation:
+    """Build the final transformation from (factory | tx, lr | schedule)."""
+    if isinstance(opt, optax.GradientTransformation):
+        return opt
+    if callable(opt):
+        return opt(learning_rate)
+    raise TypeError(
+        f"Optimizer must be an optax.GradientTransformation or a factory "
+        f"fn(learning_rate)->tx, got {type(opt).__name__}"
+    )

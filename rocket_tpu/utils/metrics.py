@@ -1,0 +1,60 @@
+"""Stock Metric implementations.
+
+The reference leaves metrics to user subclasses (``meter.py:98-111``; the
+``Accuracy`` example at ``examples/mnist.py:20-39``); common ones ship here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.meter import Metric
+
+__all__ = ["Accuracy"]
+
+
+class Accuracy(Metric):
+    """Top-1 accuracy over gathered logits/labels.
+
+    Accumulates per launch; on ``reset`` publishes to
+    ``attrs.tracker.scalars["accuracy"]`` and ``attrs.looper.state.accuracy``
+    then clears (the reference example's shape, ``examples/mnist.py:20-39``).
+    """
+
+    def __init__(
+        self,
+        logits_key: str = "logits",
+        labels_key: str = "label",
+        tag: str = "accuracy",
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        self._logits_key = logits_key
+        self._labels_key = labels_key
+        self._tag = tag
+        self._correct = 0
+        self._total = 0
+        self.value: float | None = None
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        if attrs is None or attrs.batch is None:
+            return
+        logits = np.asarray(attrs.batch[self._logits_key])
+        labels = np.asarray(attrs.batch[self._labels_key])
+        preds = logits.argmax(axis=-1)
+        self._correct += int((preds == labels).sum())
+        self._total += int(labels.shape[0])
+
+    def reset(self, attrs: Attributes | None = None) -> None:
+        if self._total:
+            self.value = self._correct / self._total
+            if attrs is not None:
+                if attrs.tracker is not None:
+                    attrs.tracker.scalars[self._tag] = self.value
+                if attrs.looper is not None:
+                    attrs.looper.state[self._tag] = self.value
+        self._correct = 0
+        self._total = 0

@@ -1,0 +1,51 @@
+"""Probe capsule — records every event it receives, for tests and debugging.
+
+SURVEY §4: the reference's 5-event protocol makes a probe capsule the natural
+test instrument (the survey itself verified the reference's event algebra with
+one); this framework ships it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+__all__ = ["Probe"]
+
+
+class Probe(Capsule):
+    """Records ``(name, event)`` tuples into a shared trace list."""
+
+    def __init__(
+        self,
+        name: str,
+        trace: Optional[list] = None,
+        statefull: bool = False,
+        priority: int = 1000,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, runtime=runtime)
+        self.name = name
+        self.trace = trace if trace is not None else []
+
+    def _record(self, event: str, attrs: Attributes | None) -> None:
+        self.trace.append((self.name, event))
+
+    def setup(self, attrs=None):
+        super().setup(attrs)
+        self._record("setup", attrs)
+
+    def set(self, attrs=None):
+        self._record("set", attrs)
+
+    def launch(self, attrs=None):
+        self._record("launch", attrs)
+
+    def reset(self, attrs=None):
+        self._record("reset", attrs)
+
+    def destroy(self, attrs=None):
+        self._record("destroy", attrs)
+        super().destroy(attrs)

@@ -1,0 +1,53 @@
+import pickle
+
+from rocket_tpu import Attributes
+
+
+def test_missing_key_reads_none():
+    attrs = Attributes()
+    assert attrs.batch is None
+    assert attrs["batch"] is None
+
+
+def test_set_get_del():
+    attrs = Attributes()
+    attrs.batch = [1, 2]
+    assert attrs.batch == [1, 2]
+    assert attrs["batch"] == [1, 2]
+    del attrs.batch
+    assert attrs.batch is None
+    del attrs.batch  # deleting a missing key is a no-op
+
+
+def test_nested_chained_access():
+    attrs = Attributes()
+    attrs.looper = {"state": {"loss": 1.5}}
+    assert attrs.looper.state.loss == 1.5
+    attrs.looper.state.loss = 2.0
+    assert attrs["looper"]["state"]["loss"] == 2.0
+
+
+def test_is_a_dict():
+    attrs = Attributes(a=1)
+    assert isinstance(attrs, dict)
+    assert dict(attrs) == {"a": 1}
+
+
+def test_flat_items():
+    attrs = Attributes(a=1, b=Attributes(c=2, d=Attributes(e=3)))
+    flat = dict(attrs.flat_items())
+    assert flat == {"a": 1, "b.c": 2, "b.d.e": 3}
+
+
+def test_copy_independent():
+    attrs = Attributes(a=1)
+    clone = attrs.copy()
+    clone.a = 2
+    assert attrs.a == 1
+
+
+def test_pickle_roundtrip():
+    attrs = Attributes(a=1, b={"c": 2})
+    restored = pickle.loads(pickle.dumps(attrs))
+    assert restored.a == 1
+    assert restored.b.c == 2

@@ -1,0 +1,99 @@
+import pytest
+
+from rocket_tpu import Attributes, Capsule, Dispatcher, Events
+from rocket_tpu.utils.probe import Probe
+
+
+def test_dispatch_routes_to_handler(runtime):
+    trace = []
+    probe = Probe("p", trace, runtime=runtime)
+    probe.bind(runtime)
+    attrs = Attributes()
+    for event in (Events.SETUP, Events.SET, Events.LAUNCH, Events.RESET, Events.DESTROY):
+        probe.dispatch(event, attrs)
+    assert [e for _, e in trace] == ["setup", "set", "launch", "reset", "destroy"]
+
+
+def test_dispatch_rejects_non_event(runtime):
+    capsule = Capsule(runtime=runtime)
+    with pytest.raises(RuntimeError):
+        capsule.dispatch("launch")
+
+
+def test_priority_ordering_stable(runtime):
+    # Higher priority runs earlier; ties keep construction order
+    # (verified reference behavior, dispatcher.py:18-20).
+    trace = []
+    children = [
+        Probe("low", trace, priority=1),
+        Probe("first_default", trace),
+        Probe("second_default", trace),
+        Probe("high", trace, priority=2000),
+    ]
+    d = Dispatcher(children, runtime=runtime)
+    d.launch(Attributes())
+    assert [n for n, _ in trace] == ["high", "first_default", "second_default", "low"]
+
+
+def test_destroy_reversed(runtime):
+    trace = []
+    d = Dispatcher([Probe("a", trace), Probe("b", trace)], runtime=runtime)
+    attrs = Attributes()
+    d.setup(attrs)
+    trace.clear()
+    d.destroy(attrs)
+    assert [n for n, _ in trace] == ["b", "a"]
+
+
+def test_checkpoint_stack_lifo(runtime):
+    a = Probe("a", statefull=True, runtime=runtime)
+    b = Probe("b", statefull=True, runtime=runtime)
+    attrs = Attributes()
+    a.setup(attrs)
+    b.setup(attrs)
+    assert runtime.checkpoint_stack == (a, b)
+    b.destroy(attrs)
+    a.destroy(attrs)
+    assert runtime.checkpoint_stack == ()
+
+
+def test_checkpoint_stack_out_of_order_destroy_raises(runtime):
+    a = Probe("a", statefull=True, runtime=runtime)
+    b = Probe("b", statefull=True, runtime=runtime)
+    a.setup(Attributes())
+    b.setup(Attributes())
+    with pytest.raises(RuntimeError, match="stack corrupted"):
+        a.destroy(Attributes())
+
+
+def test_double_registration_raises(runtime):
+    a = Probe("a", statefull=True, runtime=runtime)
+    a.setup(Attributes())
+    with pytest.raises(RuntimeError, match="twice"):
+        runtime.register_for_checkpointing(a)
+
+
+def test_setup_without_runtime_raises():
+    with pytest.raises(RuntimeError, match="no runtime"):
+        Capsule(statefull=True).setup(Attributes())
+
+
+def test_guard_rejects_non_capsule(runtime):
+    with pytest.raises(RuntimeError, match="not a Capsule"):
+        Dispatcher([object()], runtime=runtime)
+
+
+def test_repr_renders_tree(runtime):
+    d = Dispatcher([Probe("a", []), Dispatcher([Probe("b", [])])], runtime=runtime)
+    text = repr(d)
+    assert "Dispatcher(" in text
+    assert "Probe" in text
+
+
+def test_rebind_different_runtime_raises(runtime, tmp_path):
+    from rocket_tpu.runtime.context import Runtime
+
+    capsule = Capsule(runtime=runtime)
+    capsule.bind(runtime)  # idempotent
+    with pytest.raises(RuntimeError, match="different runtime"):
+        capsule.bind(Runtime(project_dir=str(tmp_path)))

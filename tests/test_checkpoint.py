@@ -1,0 +1,166 @@
+"""Checkpoint save/resume: layout, state equivalence, mid-epoch fast-forward."""
+
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.runtime.context import Runtime
+
+
+def make_dataset(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, dim)) * 3.0
+    labels = rng.integers(0, classes, size=n)
+    images = centers[labels] + rng.normal(size=(n, dim)) * 0.5
+    return [
+        {"image": images[i].astype(np.float32), "label": np.int32(labels[i])}
+        for i in range(n)
+    ]
+
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+def build(runtime, model, data, ckpt_dir, num_epochs, save_every=4, resume_from=None):
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    return rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(data, batch_size=32),
+                    module,
+                    rt.Checkpointer(
+                        output_dir=ckpt_dir,
+                        save_every=save_every,
+                        resume_from=resume_from,
+                    ),
+                ],
+                tag="train",
+            )
+        ],
+        num_epochs=num_epochs,
+        statefull=True,
+        runtime=runtime,
+    ), module
+
+
+def test_checkpoint_layout_written(tmp_path):
+    runtime = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    data = make_dataset()
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    ckpt = str(tmp_path / "ckpts")
+    tree, _ = build(runtime, model, data, ckpt, num_epochs=1)
+    tree.launch()
+    # 256/32 = 8 iterations, save_every=4 -> steps 4 and 8
+    assert sorted(os.listdir(ckpt)) == ["4", "8"]
+    step_dir = os.path.join(ckpt, "8")
+    assert set(os.listdir(step_dir)) == {"model_0.pkl", "capsules.pkl", "rng.pkl"}
+
+
+def test_resume_restores_params_and_counters(tmp_path):
+    data = make_dataset()
+    ckpt = str(tmp_path / "ckpts")
+
+    runtime1 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model1 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree1, module1 = build(runtime1, model1, data, ckpt, num_epochs=1)
+    tree1.launch()
+    # state after the run (model registry is cleared at destroy; keep a copy)
+    # -> re-read from the written checkpoint instead
+    from rocket_tpu.runtime.checkpoint_io import load_pytree
+
+    saved = load_pytree(os.path.join(ckpt, "8", "model_0.pkl"))
+
+    runtime2 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree2, module2 = build(
+        runtime2, model2, data, ckpt, num_epochs=2, resume_from=os.path.join(ckpt, "8")
+    )
+    attrs = rt.Attributes()
+    tree2.setup(attrs)
+    restored = module2.state
+    np.testing.assert_allclose(
+        np.asarray(saved["params"]["1"]["w"]),
+        np.asarray(restored["params"]["1"]["w"]),
+    )
+    assert int(np.asarray(restored["step"])) == 8
+    # The save fired DURING epoch 0 (at its last iteration), so resume lands
+    # mid-epoch: epoch 0 with 8 batches already consumed.
+    assert tree2.state_dict()["epoch_idx"] == 0
+    # The Checkpointer runs inside the dispatch wave, before the Looper
+    # advances its counter: Looper saved 7 while the Dataset saved 8. On
+    # resume the Dataset's skip is authoritative — the Looper's one extra
+    # wave no-ops via terminate, so the data stream stays exact.
+    looper = tree2.capsules[0]
+    assert looper.state_dict()["batch_idx"] == 7
+    tree2.destroy(attrs)
+
+
+def test_resume_capsules_false_skips_capsule_state(tmp_path):
+    data = make_dataset()
+    ckpt = str(tmp_path / "ckpts")
+    runtime1 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model1 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree1, _ = build(runtime1, model1, data, ckpt, num_epochs=1)
+    tree1.launch()
+
+    runtime2 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    module2 = rt.Module(
+        model2,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    ckpointer = rt.Checkpointer(
+        output_dir=ckpt, save_every=1000, resume_from=os.path.join(ckpt, "8"),
+        resume_capsules=False,
+    )
+    tree2 = rt.Launcher(
+        [rt.Looper([rt.Dataset(data, batch_size=32), module2, ckpointer], tag="train")],
+        num_epochs=1,
+        statefull=True,
+        runtime=runtime2,
+    )
+    attrs = rt.Attributes()
+    tree2.setup(attrs)
+    # model weights restored, but launcher epoch counter untouched
+    assert int(np.asarray(module2.state["step"])) == 8
+    assert tree2.state_dict()["epoch_idx"] == 0
+    tree2.destroy(attrs)
+
+
+def test_keep_last_prunes_old_checkpoints(tmp_path):
+    runtime = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    data = make_dataset()
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    ckpt = str(tmp_path / "ckpts")
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    tree = rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(data, batch_size=32),
+                    module,
+                    rt.Checkpointer(output_dir=ckpt, save_every=2, keep_last=2),
+                ],
+                tag="train",
+            )
+        ],
+        num_epochs=1,
+        runtime=runtime,
+    )
+    tree.launch()
+    assert sorted(os.listdir(ckpt), key=int) == ["6", "8"]

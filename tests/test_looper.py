@@ -1,0 +1,131 @@
+import numpy as np
+import pytest
+
+from rocket_tpu import Attributes, Dataset, Launcher, Looper
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.utils.probe import Probe
+
+
+def make_samples(n=8):
+    return [{"x": np.full((2,), float(i), np.float32)} for i in range(n)]
+
+
+def test_event_algebra_sequential_children(runtime):
+    # Child A completes its whole epoch before child B starts (launcher.py:37-45,
+    # verified reference behavior).
+    trace = []
+    a = Looper([Probe("a", trace)], tag="a", repeats=2)
+    b = Looper([Probe("b", trace)], tag="b", repeats=1, grad_enabled=False)
+    launcher = Launcher([a, b], num_epochs=2, runtime=runtime)
+    launcher.launch()
+
+    names = [n for n, e in trace if e == "launch"]
+    assert names == ["a", "a", "b"] * 2
+    # setup once each, destroy once each
+    assert [e for _, e in trace].count("setup") == 2
+    assert [e for _, e in trace].count("destroy") == 2
+
+
+def test_multi_epoch_iterates_every_epoch(runtime):
+    # The reference only iterates the first epoch (loop.py:95 bug) — fixed here.
+    trace = []
+    dataset = Dataset(make_samples(8), batch_size=4)
+    looper = Looper([dataset, Probe("work", trace)], tag="train")
+    Launcher([looper], num_epochs=3, runtime=runtime).launch()
+    launches = [n for n, e in trace if e == "launch"]
+    assert len(launches) == 6  # 2 batches x 3 epochs
+
+
+def test_epoch_idx_advances_past_finished_run(runtime):
+    # Reference off-by-one: finished run reports num_epochs-1 (launcher.py:46).
+    launcher = Launcher([Looper([Probe("p")], repeats=1)], num_epochs=2, runtime=runtime)
+    launcher.launch()
+    assert launcher.state_dict()["epoch_idx"] == 2
+
+
+def test_repeats_inferred_from_dataset(runtime):
+    dataset = Dataset(make_samples(10), batch_size=3)  # ceil(10/3) = 4
+    looper = Looper([dataset], tag="train")
+    Launcher([looper], num_epochs=1, runtime=runtime).launch()
+    assert looper._repeats == 4
+
+
+def test_repeats_uninferable_raises(runtime):
+    looper = Looper([Probe("p")], tag="train")
+    with pytest.raises(RuntimeError, match="cannot infer repeats"):
+        Launcher([looper], num_epochs=1, runtime=runtime).launch()
+
+
+def test_terminate_breaks_loop(runtime):
+    class Terminator(Capsule):
+        def __init__(self):
+            super().__init__()
+            self.count = 0
+
+        def launch(self, attrs=None):
+            self.count += 1
+            if self.count >= 2:
+                attrs.looper.terminate = True
+
+    term = Terminator()
+    looper = Looper([term], tag="train", repeats=100)
+    Launcher([looper], num_epochs=1, runtime=runtime).launch()
+    assert term.count == 2
+
+
+def test_run_every_skips_epochs(runtime):
+    trace = []
+    val = Looper([Probe("val", trace)], tag="val", repeats=1, run_every=2, grad_enabled=False)
+    Launcher([val], num_epochs=4, runtime=runtime).launch()
+    launches = [n for n, e in trace if e == "launch"]
+    assert len(launches) == 2  # epochs 0 and 2
+
+
+def test_nested_loopers_forbidden(runtime):
+    inner = Looper([Probe("p")], repeats=1)
+    with pytest.raises(RuntimeError, match="nested"):
+        Looper([inner], repeats=1)
+
+
+def test_mode_flag_set_by_looper(runtime):
+    seen = {}
+
+    class ModeSpy(Capsule):
+        def launch(self, attrs=None):
+            seen.setdefault(attrs.looper.tag, attrs.mode)
+
+    train = Looper([ModeSpy()], tag="train", repeats=1, grad_enabled=True)
+    val = Looper([ModeSpy()], tag="val", repeats=1, grad_enabled=False)
+    Launcher([train, val], num_epochs=1, runtime=runtime).launch()
+    assert seen == {"train": "train", "val": "eval"}
+
+
+def test_looper_contract_published(runtime):
+    contract = {}
+
+    class Spy(Capsule):
+        def launch(self, attrs=None):
+            contract.update(attrs.looper)
+
+    Launcher(
+        [Looper([Spy()], tag="train", repeats=3)], num_epochs=1, runtime=runtime
+    ).launch()
+    assert contract["repeats"] == 3
+    assert contract["tag"] == "train"
+    assert contract["terminate"] is False
+    assert isinstance(contract["state"], dict)
+
+
+def test_batch_cleared_each_iteration(runtime):
+    batches = []
+
+    class Spy(Capsule):
+        def __init__(self):
+            super().__init__(priority=2000)  # runs before Dataset? no - spy sees cleared batch
+
+        def launch(self, attrs=None):
+            batches.append(attrs.batch)
+
+    looper = Looper([Spy()], tag="train", repeats=2)
+    Launcher([looper], num_epochs=1, runtime=runtime).launch()
+    assert batches == [None, None]
