@@ -1,0 +1,73 @@
+"""Char-transformer on TinyShakespeare (BASELINE.json configs[2]).
+
+Canonical capsule tree for LM training: device-cached token dataset, fused
+jitted train step (AdamW + warmup-cosine), val phase with loss metric.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.text import CharTokenizer, TokenDataset, tiny_shakespeare
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    next_token_loss,
+)
+
+
+def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
+    text = tiny_shakespeare()
+    tok = CharTokenizer(text)
+    tokens = tok.encode(text)
+    split = int(len(tokens) * 0.95)
+    train_data = TokenDataset(tokens[:split], seq_len=seq_len)
+    val_data = TokenDataset(tokens[split:], seq_len=seq_len)
+
+    runtime = rt.Runtime(seed=0)
+    config = TransformerConfig.char_lm(vocab_size=tok.vocab_size, max_seq_len=seq_len)
+    model = TransformerLM(config)
+
+    steps_per_epoch = len(train_data) // batch_size
+    total_steps = max(1, steps_per_epoch * num_epochs)
+
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(train_data, batch_size=batch_size, shuffle=True,
+                               drop_last=True),
+                    rt.Module(
+                        model,
+                        capsules=[
+                            rt.Loss(next_token_loss()),
+                            rt.Optimizer(optim.adamw(weight_decay=0.1)),
+                            rt.Scheduler(
+                                optim.warmup_cosine_lr(
+                                    3e-4, warmup_steps=max(1, total_steps // 20),
+                                    decay_steps=total_steps,
+                                )
+                            ),
+                        ],
+                    ),
+                    rt.Checkpointer(output_dir="checkpoints/char_lm", save_every=500),
+                    rt.Tracker(backend="jsonl", project="char_lm"),
+                ],
+                tag="train",
+            ),
+        ],
+        num_epochs=num_epochs,
+        statefull=True,
+        runtime=runtime,
+    )
+    launcher.launch()
+    print(f"vocab={tok.vocab_size} steps={total_steps}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""GPT-2 124M pretraining config (BASELINE.json configs[4]).
+
+The multi-host v4-128 shape: ('data', 'model') mesh, Megatron-style tensor
+parallel params (parallel/sharding.gpt2_tp_rules), bfloat16 compute with
+float32 master weights, gradient accumulation, warmup-cosine schedule. On a
+single chip this runs the same program with a 1x1 mesh; on a pod slice, set
+mesh_shape to the real topology (e.g. {"data": 16, "model": 4}) — XLA places
+the collectives on ICI, and multi-host process wiring comes from
+JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES env vars (see runtime/context.py).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.text import TokenDataset, synthetic_corpus, CharTokenizer
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    next_token_loss,
+)
+from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-axis", type=int, default=None)
+    parser.add_argument("--model-axis", type=int, default=1)
+    parser.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--accum", type=int, default=1)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--small", action="store_true", help="tiny dims for smoke runs")
+    args = parser.parse_args()
+
+    n_dev = len(jax.devices())
+    data_axis = args.data_axis or (n_dev // args.model_axis)
+    runtime = rt.Runtime(
+        mesh_shape={"data": data_axis, "model": args.model_axis},
+        seed=0,
+        gradient_accumulation_steps=args.accum,
+    )
+
+    if args.small:
+        config = TransformerConfig(
+            vocab_size=512, max_seq_len=args.seq_len, dim=128, num_layers=2,
+            num_heads=4, dropout=0.0,
+        )
+    else:
+        config = TransformerConfig.gpt2_124m(max_seq_len=args.seq_len)
+    model = TransformerLM(config)
+
+    # Corpus: byte-level over the synthetic text (stands in for the real
+    # tokenized corpus; swap TokenDataset input for production data).
+    text = synthetic_corpus(num_chars=2_000_000)
+    tok = CharTokenizer(text)
+    tokens = tok.encode(text) % config.vocab_size
+    data = TokenDataset(tokens, seq_len=args.seq_len)
+
+    steps = max(1, (len(data) // args.batch) * args.epochs)
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(data, batch_size=args.batch, shuffle=True, drop_last=True),
+                    rt.Module(
+                        model,
+                        capsules=[
+                            rt.Loss(next_token_loss()),
+                            rt.Optimizer(optim.adamw(weight_decay=0.1)),
+                            rt.Scheduler(
+                                optim.warmup_cosine_lr(
+                                    6e-4, warmup_steps=max(1, steps // 50),
+                                    decay_steps=steps,
+                                )
+                            ),
+                        ],
+                        param_sharding=gpt2_tp_rules() if args.model_axis > 1 else None,
+                        compute_dtype=jnp.bfloat16,
+                        remat=not args.small,
+                    ),
+                    rt.Checkpointer(output_dir="checkpoints/gpt2", save_every=1000,
+                                    keep_last=3),
+                    rt.Tracker(backend="jsonl", project="gpt2"),
+                ],
+                tag="train",
+            ),
+        ],
+        num_epochs=args.epochs,
+        statefull=True,
+        runtime=runtime,
+    )
+    print(launcher)
+    launcher.launch()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Text/LM data: char tokenizer, sequence packing, TinyShakespeare loader.
+
+No network egress in this environment, so ``tiny_shakespeare()`` loads a
+local copy when present (``TEXT_ROOT`` or ./data) and otherwise generates a
+deterministic synthetic corpus with word- and phrase-level structure — enough
+statistical signal that a char transformer's loss drops well below the
+unigram entropy, keeping the north-star char-LM config (BASELINE.json
+configs[2]) exercisable end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["CharTokenizer", "TokenDataset", "tiny_shakespeare", "synthetic_corpus"]
+
+
+def synthetic_corpus(num_chars: int = 1_000_000, seed: int = 0) -> str:
+    """Grammar-ish pseudo-text: sentences of words drawn with skewed,
+    context-dependent frequencies (bigram word model)."""
+    rng = np.random.default_rng(seed ^ 0x7E47)
+    syllables = ["ba", "co", "di", "fu", "ga", "hi", "jo", "ku", "la", "me",
+                 "no", "pi", "qua", "ro", "su", "ti", "vo", "wi", "xa", "zu"]
+    vocab = [
+        "".join(rng.choice(syllables, size=rng.integers(1, 4)))
+        for _ in range(200)
+    ]
+    # Bigram transition table with strong structure.
+    trans = rng.dirichlet(np.full(len(vocab), 0.05), size=len(vocab))
+    pieces = []
+    total = 0
+    word = int(rng.integers(len(vocab)))
+    sentence_len = 0
+    while total < num_chars:
+        w = vocab[word]
+        pieces.append(w)
+        total += len(w) + 1
+        sentence_len += 1
+        if sentence_len >= rng.integers(5, 12):
+            pieces.append(".\n")
+            total += 2
+            sentence_len = 0
+        else:
+            pieces.append(" ")
+        word = int(rng.choice(len(vocab), p=trans[word]))
+    return "".join(pieces)[:num_chars]
+
+
+def tiny_shakespeare(root: Optional[str] = None) -> str:
+    root = root or os.environ.get("TEXT_ROOT", "data")
+    for name in ("tinyshakespeare.txt", "tiny_shakespeare.txt", "input.txt"):
+        path = os.path.join(root, name)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+    return synthetic_corpus()
+
+
+class CharTokenizer:
+    def __init__(self, text: str):
+        chars = sorted(set(text))
+        self.vocab = chars
+        self.vocab_size = len(chars)
+        self._stoi = {ch: i for i, ch in enumerate(chars)}
+
+    def encode(self, text: str) -> np.ndarray:
+        return np.asarray([self._stoi[c] for c in text], np.int32)
+
+    def decode(self, tokens) -> str:
+        return "".join(self.vocab[int(t)] for t in tokens)
+
+
+class TokenDataset:
+    """Fixed-length windows over a token stream.
+
+    Sample i is ``tokens[i*stride : i*stride + seq_len]`` — batches are
+    ``{"tokens": (B, T) int32}``; the next-token objective shifts internally.
+    Supports the loader's vectorized ``get_batch`` fast path and therefore the
+    device-resident cache.
+    """
+
+    def __init__(self, tokens: np.ndarray, seq_len: int, stride: Optional[int] = None):
+        self._tokens = np.asarray(tokens, np.int32)
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        self._n = max(0, (len(self._tokens) - seq_len) // self.stride + 1)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: int) -> dict:
+        start = idx * self.stride
+        return {"tokens": self._tokens[start : start + self.seq_len]}
+
+    def get_batch(self, indices: np.ndarray) -> dict:
+        starts = np.asarray(indices) * self.stride
+        window = starts[:, None] + np.arange(self.seq_len)[None, :]
+        return {"tokens": self._tokens[window]}

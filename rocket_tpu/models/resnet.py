@@ -1,0 +1,225 @@
+"""ResNet family (18/34/50/101) — NHWC, sync batchnorm by construction.
+
+North-star configs (BASELINE.json configs[1,3]): CIFAR-10 ResNet-18 and
+ImageNet ResNet-50 DDP. TPU notes: NHWC keeps channels on the lane dim; the
+batchnorm reductions are over the global (mesh-sharded) batch so multi-device
+training is cross-replica batchnorm with no extra code; downsampling shortcuts
+use 1x1 strided convs (projection option B).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu import nn
+from rocket_tpu.nn.layers import BatchNorm, Conv2D, Dense
+from rocket_tpu.nn.module import Layer, Model, Variables
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101"]
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding="SAME"):
+        self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding, use_bias=False)
+        self.bn = BatchNorm(cout)
+
+    def init(self, key):
+        return {
+            "params": {
+                "conv": self.conv.init(key)["params"],
+                "bn": self.bn.init_params(key),
+            },
+            "state": {"bn": self.bn.init_state()},
+        }
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p, s = variables["params"], variables["state"]
+        x, _ = self.conv.apply({"params": p["conv"], "state": {}}, x)
+        x, bn_state = self.bn.apply({"params": p["bn"], "state": s["bn"]}, x, mode=mode)
+        return x, {"bn": bn_state}
+
+
+class _BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, cin, width, stride):
+        self.cbr1 = _ConvBN(cin, width, 3, stride=stride)
+        self.cbr2 = _ConvBN(width, width, 3)
+        self.downsample = (
+            _ConvBN(cin, width, 1, stride=stride)
+            if stride != 1 or cin != width
+            else None
+        )
+
+    def init(self, key):
+        keys = jax.random.split(key, 3)
+        params, state = {}, {}
+        for name, layer, k in (
+            ("c1", self.cbr1, keys[0]),
+            ("c2", self.cbr2, keys[1]),
+        ):
+            sub = layer.init(k)
+            params[name], state[name] = sub["params"], sub["state"]
+        if self.downsample is not None:
+            sub = self.downsample.init(keys[2])
+            params["down"], state["down"] = sub["params"], sub["state"]
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p, s = variables["params"], variables["state"]
+        new_state = {}
+        h, new_state["c1"] = self.cbr1.apply(
+            {"params": p["c1"], "state": s["c1"]}, x, mode=mode
+        )
+        h = jax.nn.relu(h)
+        h, new_state["c2"] = self.cbr2.apply(
+            {"params": p["c2"], "state": s["c2"]}, h, mode=mode
+        )
+        if self.downsample is not None:
+            x, new_state["down"] = self.downsample.apply(
+                {"params": p["down"], "state": s["down"]}, x, mode=mode
+            )
+        return jax.nn.relu(x + h), new_state
+
+
+class _Bottleneck(Layer):
+    expansion = 4
+
+    def __init__(self, cin, width, stride):
+        cout = width * self.expansion
+        self.cbr1 = _ConvBN(cin, width, 1)
+        self.cbr2 = _ConvBN(width, width, 3, stride=stride)
+        self.cbr3 = _ConvBN(width, cout, 1)
+        self.downsample = (
+            _ConvBN(cin, cout, 1, stride=stride)
+            if stride != 1 or cin != cout
+            else None
+        )
+
+    def init(self, key):
+        keys = jax.random.split(key, 4)
+        params, state = {}, {}
+        for name, layer, k in (
+            ("c1", self.cbr1, keys[0]),
+            ("c2", self.cbr2, keys[1]),
+            ("c3", self.cbr3, keys[2]),
+        ):
+            sub = layer.init(k)
+            params[name], state[name] = sub["params"], sub["state"]
+        if self.downsample is not None:
+            sub = self.downsample.init(keys[3])
+            params["down"], state["down"] = sub["params"], sub["state"]
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p, s = variables["params"], variables["state"]
+        new_state = {}
+        h, new_state["c1"] = self.cbr1.apply({"params": p["c1"], "state": s["c1"]}, x, mode=mode)
+        h = jax.nn.relu(h)
+        h, new_state["c2"] = self.cbr2.apply({"params": p["c2"], "state": s["c2"]}, h, mode=mode)
+        h = jax.nn.relu(h)
+        h, new_state["c3"] = self.cbr3.apply({"params": p["c3"], "state": s["c3"]}, h, mode=mode)
+        if self.downsample is not None:
+            x, new_state["down"] = self.downsample.apply(
+                {"params": p["down"], "state": s["down"]}, x, mode=mode
+            )
+        return jax.nn.relu(x + h), new_state
+
+
+class ResNet(Model):
+    """Batch contract: reads ``batch["image"]`` (B,H,W,C or B,H,W), writes
+    ``batch["logits"]``.
+
+    ``stem="imagenet"``: 7x7/2 conv + 3x3/2 maxpool; ``stem="cifar"``: 3x3/1
+    conv, no pool (standard CIFAR variant).
+    """
+
+    def __init__(
+        self,
+        block: str,
+        stage_sizes: Sequence[int],
+        num_classes: int = 1000,
+        in_channels: int = 3,
+        stem: str = "imagenet",
+        image_key: str = "image",
+        logits_key: str = "logits",
+    ):
+        block_cls = {"basic": _BasicBlock, "bottleneck": _Bottleneck}[block]
+        self.stem_kind = stem
+        if stem == "imagenet":
+            self.stem = _ConvBN(in_channels, 64, 7, stride=2)
+            self.pool = nn.MaxPool2D(3, stride=2, padding="SAME")
+        else:
+            self.stem = _ConvBN(in_channels, 64, 3, stride=1)
+            self.pool = None
+
+        self.blocks: list[Layer] = []
+        cin = 64
+        for stage, num_blocks in enumerate(stage_sizes):
+            width = 64 * (2**stage)
+            for i in range(num_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                blk = block_cls(cin, width, stride)
+                self.blocks.append(blk)
+                cin = width * block_cls.expansion
+        self.head = Dense(cin, num_classes)
+        self.image_key = image_key
+        self.logits_key = logits_key
+
+    def init(self, key: jax.Array) -> Variables:
+        keys = jax.random.split(key, len(self.blocks) + 2)
+        stem = self.stem.init(keys[0])
+        params = {"stem": stem["params"], "blocks": {}}
+        state = {"stem": stem["state"], "blocks": {}}
+        for i, blk in enumerate(self.blocks):
+            sub = blk.init(keys[1 + i])
+            params["blocks"][str(i)] = sub["params"]
+            state["blocks"][str(i)] = sub["state"]
+        params["head"] = self.head.init(keys[-1])["params"]
+        return {"params": params, "state": state}
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        p, s = variables["params"], variables["state"]
+        x = batch[self.image_key]
+        if x.ndim == 3:
+            x = x[..., None]
+
+        new_state = {"blocks": {}}
+        x, new_state["stem"] = self.stem.apply(
+            {"params": p["stem"], "state": s["stem"]}, x, mode=mode
+        )
+        x = jax.nn.relu(x)
+        if self.pool is not None:
+            x, _ = self.pool.apply({"params": {}, "state": {}}, x)
+
+        for i, blk in enumerate(self.blocks):
+            x, new_state["blocks"][str(i)] = blk.apply(
+                {"params": p["blocks"][str(i)], "state": s["blocks"][str(i)]},
+                x,
+                mode=mode,
+            )
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+        out = dict(batch)
+        out[self.logits_key] = logits
+        return out, new_state
+
+
+def resnet18(num_classes=1000, **kw) -> ResNet:
+    return ResNet("basic", [2, 2, 2, 2], num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw) -> ResNet:
+    return ResNet("basic", [3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw) -> ResNet:
+    return ResNet("bottleneck", [3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw) -> ResNet:
+    return ResNet("bottleneck", [3, 4, 23, 3], num_classes=num_classes, **kw)

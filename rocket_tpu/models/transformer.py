@@ -1,0 +1,207 @@
+"""Decoder-only transformer LM (char-LM and GPT-2 families).
+
+North-star configs (BASELINE.json configs[2,4]): TinyShakespeare
+char-Transformer and GPT-2 124M with pjit param sharding + bfloat16. The
+reference has no model code — models are user-space — but the framework ships
+these as the flagship north-star models.
+
+TPU design: pre-LN blocks, fused QKV, GELU MLP at 4x width, float32 layernorm/
+softmax inside a bf16 compute path, GPT-2 residual init scaling. Tensor
+parallelism comes from OUTSIDE the model: ``parallel/sharding.py`` maps the
+param tree produced here onto a ('data', 'model') mesh (attention/MLP kernels
+sharded on the model axis), XLA inserting the collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu import nn
+from rocket_tpu.nn.attention import MultiHeadAttention
+from rocket_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm
+from rocket_tpu.nn.module import Layer, Model, Variables
+
+__all__ = ["TransformerConfig", "TransformerLM", "Block", "next_token_loss"]
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int
+    max_seq_len: int
+    dim: int
+    num_layers: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    tied_embeddings: bool = True
+
+    @staticmethod
+    def char_lm(vocab_size: int = 128, max_seq_len: int = 256) -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            dim=256, num_layers=6, num_heads=8, dropout=0.1,
+        )
+
+    @staticmethod
+    def gpt2_124m(vocab_size: int = 50257, max_seq_len: int = 1024) -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            dim=768, num_layers=12, num_heads=12, dropout=0.1,
+        )
+
+
+class Block(Layer):
+    """Pre-LN transformer block: x += attn(ln1(x)); x += mlp(ln2(x))."""
+
+    def __init__(self, config: TransformerConfig, layer_idx: int):
+        c = config
+        self.ln1 = LayerNorm(c.dim)
+        self.attn = MultiHeadAttention(
+            c.dim, c.num_heads, causal=True, dropout=c.dropout
+        )
+        self.ln2 = LayerNorm(c.dim)
+        self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
+        self.fc_out = Dense(c.mlp_ratio * c.dim, c.dim)
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+        # GPT-2: residual projections scaled by 1/sqrt(2*num_layers).
+        self._resid_scale = (2 * c.num_layers) ** -0.5
+        self.layer_idx = layer_idx
+
+    def init_params(self, key):
+        keys = jax.random.split(key, 4)
+        params = {
+            "ln1": self.ln1.init(keys[0])["params"],
+            "attn": self.attn.init(keys[1])["params"],
+            "ln2": self.ln2.init(keys[2])["params"],
+            "mlp": {},
+        }
+        k_in, k_out = jax.random.split(keys[3])
+        params["mlp"]["fc_in"] = self.fc_in.init(k_in)["params"]
+        params["mlp"]["fc_out"] = self.fc_out.init(k_out)["params"]
+        # Residual-output scaling (attn.proj and fc_out).
+        params["attn"]["proj"]["w"] = params["attn"]["proj"]["w"] * self._resid_scale
+        params["mlp"]["fc_out"]["w"] = params["mlp"]["fc_out"]["w"] * self._resid_scale
+        return params
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        rngs = (
+            jax.random.split(jax.random.fold_in(rng, self.layer_idx), 3)
+            if rng is not None
+            else (None, None, None)
+        )
+
+        h, _ = self.ln1.apply({"params": p["ln1"], "state": {}}, x)
+        h, _ = self.attn.apply(
+            {"params": p["attn"], "state": {}}, h, mode=mode, rng=rngs[0]
+        )
+        if self.dropout is not None:
+            h, _ = self.dropout.apply({"params": {}, "state": {}}, h, mode=mode, rng=rngs[1])
+        x = x + h
+
+        h, _ = self.ln2.apply({"params": p["ln2"], "state": {}}, x)
+        h, _ = self.fc_in.apply({"params": p["mlp"]["fc_in"], "state": {}}, h)
+        h = jax.nn.gelu(h)
+        h, _ = self.fc_out.apply({"params": p["mlp"]["fc_out"], "state": {}}, h)
+        if self.dropout is not None:
+            h, _ = self.dropout.apply({"params": {}, "state": {}}, h, mode=mode, rng=rngs[2])
+        return x + h, variables["state"]
+
+
+class TransformerLM(Model):
+    """Batch contract: reads ``batch["tokens"]`` (B, T) int32, writes
+    ``batch["logits"]`` (B, T, V)."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        tokens_key: str = "tokens",
+        logits_key: str = "logits",
+    ):
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.dim)
+        self.wpe = Embedding(config.max_seq_len, config.dim)
+        self.blocks = [Block(config, i) for i in range(config.num_layers)]
+        self.ln_f = LayerNorm(config.dim)
+        self.head = (
+            None
+            if config.tied_embeddings
+            else Dense(config.dim, config.vocab_size, use_bias=False)
+        )
+        self.drop = Dropout(config.dropout) if config.dropout else None
+        self.tokens_key = tokens_key
+        self.logits_key = logits_key
+
+    def init(self, key: jax.Array) -> Variables:
+        keys = jax.random.split(key, len(self.blocks) + 3)
+        params = {
+            "wte": self.wte.init(keys[0])["params"],
+            "wpe": self.wpe.init(keys[1])["params"],
+            "blocks": {
+                str(i): block.init_params(keys[2 + i])
+                for i, block in enumerate(self.blocks)
+            },
+            "ln_f": self.ln_f.init(keys[-1])["params"],
+        }
+        if self.head is not None:
+            params["head"] = self.head.init(jax.random.fold_in(key, 99))["params"]
+        return {"params": params, "state": {}}
+
+    def num_params(self, variables: Variables) -> int:
+        return sum(int(l.size) for l in jax.tree.leaves(variables["params"]))
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        p = variables["params"]
+        tokens = batch[self.tokens_key]
+        b, t = tokens.shape
+        if t > self.config.max_seq_len:
+            raise ValueError(
+                f"sequence length {t} > max_seq_len {self.config.max_seq_len}"
+            )
+
+        x = jnp.take(p["wte"]["table"], tokens, axis=0)
+        x = x + p["wpe"]["table"][:t]
+        if self.drop is not None:
+            x, _ = self.drop.apply(
+                {"params": {}, "state": {}}, x, mode=mode,
+                rng=None if rng is None else jax.random.fold_in(rng, 7),
+            )
+
+        for i, block in enumerate(self.blocks):
+            x, _ = block.apply(
+                {"params": p["blocks"][str(i)], "state": {}}, x, mode=mode, rng=rng
+            )
+
+        x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
+        if self.head is not None:
+            logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+        else:
+            # Tied head: project back through the embedding table.
+            logits = jnp.einsum(
+                "btd,vd->btv", x, p["wte"]["table"].astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        out = dict(batch)
+        out[self.logits_key] = logits
+        return out, variables["state"]
+
+
+def next_token_loss(
+    logits_key: str = "logits", tokens_key: str = "tokens"
+):
+    """Objective: mean cross-entropy of logits[:, :-1] vs tokens[:, 1:]."""
+    import optax
+
+    def objective(batch):
+        logits = batch[logits_key][:, :-1]
+        targets = batch[tokens_key][:, 1:]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets
+        ).mean()
+
+    return objective

@@ -1,0 +1,126 @@
+"""Attention layers — MXU-friendly multi-head attention.
+
+The reference framework carries no attention code (SURVEY §0: it is
+model-agnostic); attention enters through the north-star configs
+(char-Transformer, GPT-2 124M — BASELINE.json configs[2,4]). Design points
+for TPU:
+
+* head_dim kept a multiple of 128 when possible (lane dimension feeds the
+  MXU); computations batched as one ``(B, H, T, D)`` einsum per projection;
+* softmax in float32 regardless of compute dtype (bf16-safe);
+* causal masking via a lower-triangular bias added pre-softmax — XLA fuses
+  mask + softmax + matmul chains;
+* the sequence axis can be sharded: see ``parallel/ring_attention.py`` for
+  the shard_map ring variant that exchanges KV blocks over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.nn.layers import Dense
+from rocket_tpu.nn.module import Layer
+
+__all__ = ["MultiHeadAttention", "dot_product_attention"]
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """(B, H, T, D) attention with float32 softmax.
+
+    Baseline XLA path — fused well by the compiler; the pallas flash kernel
+    (``ops/flash_attention.py``) is a drop-in for long sequences.
+    """
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(v.dtype), v
+    )
+
+
+class MultiHeadAttention(Layer):
+    """Self-attention with fused QKV projection.
+
+    Parameters follow GPT-2 conventions: ``features`` is the model width,
+    split across ``num_heads``. The QKV projection is one ``(d, 3d)`` matmul
+    (a single MXU pass) and the output projection one ``(d, d)``.
+    """
+
+    def __init__(
+        self,
+        features: int,
+        num_heads: int,
+        causal: bool = True,
+        dropout: float = 0.0,
+        use_bias: bool = True,
+    ):
+        if features % num_heads != 0:
+            raise ValueError(
+                f"MultiHeadAttention: features {features} not divisible by "
+                f"num_heads {num_heads}"
+            )
+        self.features = features
+        self.num_heads = num_heads
+        self.head_dim = features // num_heads
+        self.causal = causal
+        self.dropout = dropout
+        self.qkv = Dense(features, 3 * features, use_bias=use_bias)
+        self.proj = Dense(
+            features,
+            features,
+            use_bias=use_bias,
+            # GPT-2 style residual-scaled init is applied at the model level.
+        )
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "qkv": self.qkv.init(k1)["params"],
+            "proj": self.proj.init(k2)["params"],
+        }
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        b, t, _ = x.shape
+        qkv, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
+        qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+        q, k, v = (
+            jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
+        )  # each (B, H, T, D)
+
+        out = dot_product_attention(q, k, v, causal=self.causal)
+
+        if self.dropout and mode == "train":
+            if rng is None:
+                raise ValueError("MultiHeadAttention: dropout needs rng in train")
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, 1), keep, out.shape
+            )
+            out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
+
+        out = jnp.moveaxis(out, 1, 2).reshape(b, t, self.features)
+        out, _ = self.proj.apply({"params": p["proj"], "state": {}}, out)
+        return out, variables["state"]
+
+    def __repr__(self):
+        return f"MultiHeadAttention(d={self.features}, h={self.num_heads})"

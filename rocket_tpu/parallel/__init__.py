@@ -1,0 +1,10 @@
+from rocket_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
+from rocket_tpu.parallel.sharding import fsdp_rules, gpt2_tp_rules, make_rules
+
+__all__ = [
+    "fsdp_rules",
+    "gpt2_tp_rules",
+    "make_rules",
+    "ring_attention",
+    "ring_attention_sharded",
+]

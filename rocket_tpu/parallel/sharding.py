@@ -1,0 +1,70 @@
+"""Param-sharding rule builders: map param-tree paths to PartitionSpecs.
+
+The reference's only parallelism is DDP via Accelerate (SURVEY §2b); tensor
+parallel / fsdp layouts here are pure *sharding declarations* — the model code
+is unchanged and XLA GSPMD inserts the collectives over ICI. A rule set is a
+list of ``(glob_pattern, spec)`` pairs matched against the '/'-joined param
+path; first match wins. Pass the resulting function as ``Module(...,
+param_sharding=rule_fn)``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Callable, Optional, Sequence, Tuple
+
+__all__ = ["make_rules", "gpt2_tp_rules", "fsdp_rules"]
+
+Spec = Optional[Tuple]
+RuleFn = Callable[[Tuple[str, ...], object], Spec]
+
+
+def make_rules(rules: Sequence[Tuple[str, Spec]]) -> RuleFn:
+    """Build a param_sharding fn from ``[(glob, spec), ...]``; first match
+    wins; no match -> replicated (None)."""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        joined = "/".join(path)
+        for pattern, spec in rules:
+            if fnmatch.fnmatch(joined, pattern):
+                return spec
+        return None
+
+    return rule_fn
+
+
+def gpt2_tp_rules(axis: str = "model") -> RuleFn:
+    """Megatron-style tensor parallelism for :class:`TransformerLM` params.
+
+    Column-parallel (output dim sharded): QKV and MLP-in kernels + biases —
+    each device computes a head/neuron slice with no communication.
+    Row-parallel (input dim sharded): attention proj and MLP-out kernels —
+    XLA inserts the psum on the residual add. Embedding table sharded over
+    the vocab dim (the tied-head einsum reduces over the model dim, so only
+    the logits all-gather crosses devices).
+    """
+    return make_rules(
+        [
+            ("*/attn/qkv/w", (None, axis)),
+            ("*/attn/qkv/b", (axis,)),
+            ("*/attn/proj/w", (axis, None)),
+            ("*/mlp/fc_in/w", (None, axis)),
+            ("*/mlp/fc_in/b", (axis,)),
+            ("*/mlp/fc_out/w", (axis, None)),
+            ("wte/table", (axis, None)),
+            ("head/w", (None, axis)),
+        ]
+    )
+
+
+def fsdp_rules(axis: str = "data", min_size: int = 2**16) -> RuleFn:
+    """ZeRO-3-style fully-sharded layout: every large param sharded on its
+    first axis (XLA all-gathers params per-layer and reduce-scatters grads)."""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        shape = getattr(leaf, "shape", ())
+        if not shape or leaf.size < min_size:
+            return None
+        return (axis,) + (None,) * (len(shape) - 1)
+
+    return rule_fn

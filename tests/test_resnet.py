@@ -1,0 +1,86 @@
+"""ResNet: shapes, param counts, batchnorm state updates, e2e training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.resnet import resnet18, resnet50
+
+
+def n_params(variables):
+    return sum(int(l.size) for l in jax.tree.leaves(variables["params"]))
+
+
+def test_resnet18_cifar_shapes_and_params():
+    model = resnet18(num_classes=10, stem="cifar")
+    variables = model.init(jax.random.key(0))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    out, new_state = model.apply(variables, {"image": x}, mode="eval")
+    assert out["logits"].shape == (2, 10)
+    # torchvision resnet18 (CIFAR head): ~11.17M params
+    assert abs(n_params(variables) - 11_173_962) < 120_000, n_params(variables)
+
+
+def test_resnet50_param_count():
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.key(0))
+    # torchvision resnet50: 25,557,032 params
+    assert abs(n_params(variables) - 25_557_032) < 200_000, n_params(variables)
+
+
+def test_batchnorm_state_updates_in_train_only():
+    model = resnet18(num_classes=10, stem="cifar")
+    variables = model.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)), jnp.float32)
+
+    _, train_state = model.apply(variables, {"image": x}, mode="train")
+    _, eval_state = model.apply(variables, {"image": x}, mode="eval")
+
+    before = variables["state"]["stem"]["bn"]["mean"]
+    assert not np.allclose(np.asarray(train_state["stem"]["bn"]["mean"]), np.asarray(before))
+    np.testing.assert_array_equal(
+        np.asarray(eval_state["stem"]["bn"]["mean"]), np.asarray(before)
+    )
+
+
+def test_resnet_trains_on_mesh(runtime8):
+    # Tiny images, 8-way data parallel with batchnorm state in the train step.
+    rng = np.random.default_rng(0)
+    n, classes = 256, 4
+    labels = rng.integers(0, classes, size=n)
+    images = rng.normal(size=(n, 16, 16, 3)).astype(np.float32)
+    images += labels[:, None, None, None] * 0.5  # separable signal
+    data = [
+        {"image": images[i], "label": np.int32(labels[i])} for i in range(n)
+    ]
+
+    def ce(b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            b["logits"], b["label"]
+        ).mean()
+
+    model = resnet18(num_classes=classes, stem="cifar")
+    losses = []
+
+    class Spy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+
+        def launch(self, attrs=None):
+            if attrs.mode == "train" and attrs.looper.state.loss is not None:
+                losses.append(float(np.asarray(attrs.looper.state.loss)))
+
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(ce), rt.Optimizer(optim.momentum(), learning_rate=0.05)],
+    )
+    rt.Launcher(
+        [rt.Looper([rt.Dataset(data, batch_size=32), module, Spy()],
+                   tag="train", progress=False)],
+        num_epochs=3,
+        runtime=runtime8,
+    ).launch()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
