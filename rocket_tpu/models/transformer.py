@@ -38,6 +38,8 @@ class TransformerConfig:
     mlp_ratio: int = 4
     dropout: float = 0.0
     tied_embeddings: bool = True
+    #: "auto" | "xla" | "flash" — see ``nn.attention.resolve_impl``.
+    attention_impl: str = "auto"
 
     @staticmethod
     def char_lm(vocab_size: int = 128, max_seq_len: int = 256) -> "TransformerConfig":
@@ -61,7 +63,8 @@ class Block(Layer):
         c = config
         self.ln1 = LayerNorm(c.dim)
         self.attn = MultiHeadAttention(
-            c.dim, c.num_heads, causal=True, dropout=c.dropout
+            c.dim, c.num_heads, causal=True, dropout=c.dropout,
+            impl=c.attention_impl,
         )
         self.ln2 = LayerNorm(c.dim)
         self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
@@ -180,11 +183,11 @@ class TransformerLM(Model):
         if self.head is not None:
             logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
         else:
-            # Tied head: project back through the embedding table.
-            logits = jnp.einsum(
-                "btd,vd->btv", x, p["wte"]["table"].astype(x.dtype),
-                preferred_element_type=jnp.float32,
-            )
+            # Tied head: project back through the embedding table. Logits
+            # stay in the compute dtype — at GPT-2 shapes an f32 (B, T, V)
+            # materialization costs ~6ms/step in HBM traffic; the objective
+            # upcasts to f32 for the softmax math (next_token_loss).
+            logits = jnp.einsum("btd,vd->btv", x, p["wte"]["table"].astype(x.dtype))
 
         out = dict(batch)
         out[self.logits_key] = logits

@@ -25,7 +25,29 @@ import jax.numpy as jnp
 from rocket_tpu.nn.layers import Dense
 from rocket_tpu.nn.module import Layer
 
-__all__ = ["MultiHeadAttention", "dot_product_attention"]
+__all__ = ["MultiHeadAttention", "dot_product_attention", "resolve_impl"]
+
+
+def resolve_impl(impl: str, t: int, d: int) -> str:
+    """Resolve an ``attention_impl`` of "auto" to a concrete implementation.
+
+    "auto" picks the pallas flash kernel when running compiled on an
+    accelerator with shapes the kernel supports (T a multiple of a supported
+    block size, D <= 128), and the XLA path otherwise — including the
+    virtual-CPU test mesh (where pallas would run interpreted, orders of
+    magnitude slower) and multi-device runs (where the kernel would need a
+    shard_map seam; for sequence sharding see
+    ``parallel/ring_attention.py``, not yet selectable here).
+    """
+    if impl != "auto":
+        return impl
+    if jax.devices()[0].platform == "cpu" or jax.device_count() > 1:
+        return "xla"
+    from rocket_tpu.ops.flash_attention import pick_block
+
+    if d > 128 or pick_block(t) is None:
+        return "xla"
+    return "flash"
 
 
 def dot_product_attention(
@@ -72,17 +94,21 @@ class MultiHeadAttention(Layer):
         causal: bool = True,
         dropout: float = 0.0,
         use_bias: bool = True,
+        impl: str = "auto",
     ):
         if features % num_heads != 0:
             raise ValueError(
                 f"MultiHeadAttention: features {features} not divisible by "
                 f"num_heads {num_heads}"
             )
+        if impl not in ("auto", "xla", "flash"):
+            raise ValueError(f"MultiHeadAttention: unknown impl {impl!r}")
         self.features = features
         self.num_heads = num_heads
         self.head_dim = features // num_heads
         self.causal = causal
         self.dropout = dropout
+        self.impl = impl
         self.qkv = Dense(features, 3 * features, use_bias=use_bias)
         self.proj = Dense(
             features,
@@ -103,11 +129,19 @@ class MultiHeadAttention(Layer):
         b, t, _ = x.shape
         qkv, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+
         q, k, v = (
             jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
         )  # each (B, H, T, D)
 
-        out = dot_product_attention(q, k, v, causal=self.causal)
+        impl = resolve_impl(self.impl, t, self.head_dim)
+        if impl == "flash":
+            from rocket_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            out = dot_product_attention(q, k, v, causal=self.causal)
+        out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)
 
         if self.dropout and mode == "train":
             if rng is None:
@@ -118,7 +152,7 @@ class MultiHeadAttention(Layer):
             )
             out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
 
-        out = jnp.moveaxis(out, 1, 2).reshape(b, t, self.features)
+        out = out.reshape(b, t, self.features)
         out, _ = self.proj.apply({"params": p["proj"], "state": {}}, out)
         return out, variables["state"]
 

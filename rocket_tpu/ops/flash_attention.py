@@ -1,0 +1,400 @@
+"""Flash attention — pallas TPU kernel (fwd + bwd, causal or full).
+
+Blockwise online-softmax attention that never materializes the (T, T) score
+matrix: per query block, KV blocks stream through VMEM while running max /
+normalizer / accumulator stats are carried in f32 scratch (the flash
+attention recurrence).
+
+The reference framework has no attention code at all (SURVEY §0 — it is
+model-agnostic); attention enters through the north-star configs
+(BASELINE.json configs[2,4]). This kernel is the TPU-native hot-op
+counterpart of what torch users get from ``F.scaled_dot_product_attention``.
+
+Performance notes (what the profiler said, and what this design does):
+
+* operands are (B, H, T, D) — mosaic requires the last two block dims to
+  tile (8, 128) or equal the array dims, which rules out slicing a
+  middle-position head axis;
+* at GPT-2's D=64, one elementwise pass over a (bq, bk) score block costs
+  as much VPU time as the whole QK^T matmul costs MXU time, so VPU passes
+  are minimized: causal masking runs **only on diagonal blocks** (fully
+  masked blocks are skipped, interior blocks take a mask-free path), and
+  the softmax works in base-2 (``exp2``) so the scale folds into one fma;
+* all matmuls declare ``preferred_element_type=jnp.float32``; softmax
+  statistics and accumulators stay f32 while operands stay bf16;
+* TPU grids iterate sequentially with the last axis innermost, so f32
+  scratch carries across the kv sweep and outputs flush on the last visit
+  (see /opt/skills/guides/pallas_guide.md).
+
+On non-TPU backends (the virtual-CPU test mesh) the kernels run in pallas
+interpret mode, so the same code path is unit-testable without a chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+_LOG2E = math.log2(math.e)
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform == "cpu"
+
+
+def pick_block(t: int, preferred: int = 512) -> Optional[int]:
+    """Largest supported block size (<= preferred) that divides ``t``.
+
+    Shared with ``nn.attention.resolve_impl`` so the "can flash handle this
+    sequence length" predicate lives in exactly one place.
+    """
+    for block in (preferred, 256, 128):
+        if block <= preferred and t % block == 0 and block <= t:
+            return block
+    return None
+
+
+def _causal_mask(s, block_q: int, block_k: int):
+    """Lower-triangular mask for an aligned diagonal block."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                scale2, causal, block_q, block_k):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # Diagonal alignment assumes block_q == block_k (enforced by caller for
+    # causal). Interior blocks run mask-free; blocks above the diagonal are
+    # skipped entirely.
+    def tile(masked: bool):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        # s2 = (q . k) * scale * log2(e): base-2 domain, scale folded in.
+        s2 = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale2  # (block_q, block_k)
+        if masked:
+            s2 = _causal_mask(s2, block_q, block_k)
+        m_prev = m_s[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=-1, keepdims=True))
+        p = jnp.exp2(s2 - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * alpha + pv
+        m_s[:] = m_new
+
+    if causal:
+        @pl.when(ik < iq)
+        def _interior():
+            tile(masked=False)
+
+        @pl.when(ik == iq)
+        def _diagonal():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        l = l_s[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        # lse kept in the base-2 domain: lse2 = m2 + log2(l).
+        lse_ref[0, 0] = m_s[:] + jnp.log2(safe_l)
+
+
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    scale2 = _LOG2E / math.sqrt(d)
+    nq, nk = t // block_q, tk // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale2=scale2, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, scale2, causal, block_q, block_k):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def tile(masked: bool):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s2 = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale2
+        if masked:
+            s2 = _causal_mask(s2, block_q, block_k)
+        p = jnp.exp2(s2 - lse_ref[0, 0])
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ik < iq)
+        def _interior():
+            tile(masked=False)
+
+        @pl.when(ik == iq)
+        def _diagonal():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, scale2, causal, block_q, block_k):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def tile(masked: bool):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s2 = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale2
+        if masked:
+            s2 = _causal_mask(s2, block_q, block_k)
+        p = jnp.exp2(s2 - lse_ref[0, 0])  # (bq, bk)
+        do = do_ref[0, 0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+
+    if causal:
+        @pl.when(ik < iq)
+        def _interior():
+            tile(masked=False)
+
+        @pl.when(ik == iq)
+        def _diagonal():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(iq == nq - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    scale2 = _LOG2E / math.sqrt(d)
+    nq, nk = t // block_q, tk // block_k
+
+    # delta = rowsum(dout * out), column layout (B, H, T, 1) to match lse.
+    delta = jnp.sum(
+        out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # (B, H, T, 1)
+
+    common = dict(scale=scale, scale2=scale2, causal=causal,
+                  block_q=block_q, block_k=block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public op
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+    return _bwd(causal, block_q, block_k, interpret, res, dout)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention for (B, H, T, D) operands.
+
+    Differentiable (custom VJP with the standard recomputation backward).
+    ``T`` must be divisible by the block sizes (callers fall back to the XLA
+    path otherwise — see ``nn/attention.py``); causal additionally requires
+    square aligned blocks. Softmax statistics and all accumulators are f32.
+    """
+    t = q.shape[2]
+    tk = k.shape[2]
+    if causal and t != tk:
+        raise ValueError("flash_attention: causal requires t_q == t_kv.")
+    bq = pick_block(t, min(block_q, t))
+    bk = pick_block(tk, min(block_k, tk))
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention: seq lens ({t}, {tk}) must be multiples of a "
+            "supported block size (128); use the XLA path for ragged shapes."
+        )
+    if causal:
+        # Diagonal-block masking assumes aligned square blocks.
+        bq = bk = min(bq, bk)
+    block_q, block_k = bq, bk
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
