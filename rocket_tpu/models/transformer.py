@@ -40,12 +40,19 @@ class TransformerConfig:
     tied_embeddings: bool = True
     #: "auto" | "xla" | "flash" — see ``nn.attention.resolve_impl``.
     attention_impl: str = "auto"
+    #: Activation dtype for the trunk (e.g. "bfloat16"). The LM's input is
+    #: int tokens, so ``Module(compute_dtype=...)``'s float-batch cast never
+    #: fires — without this the f32 embedding gather silently promotes the
+    #: ENTIRE model to f32 compute (≈2x MXU time). Params stay f32 masters;
+    #: layernorm/softmax math stays f32 internally.
+    activation_dtype: Optional[str] = None
 
     @staticmethod
     def char_lm(vocab_size: int = 128, max_seq_len: int = 256) -> "TransformerConfig":
         return TransformerConfig(
             vocab_size=vocab_size, max_seq_len=max_seq_len,
             dim=256, num_layers=6, num_heads=8, dropout=0.1,
+            activation_dtype="bfloat16",
         )
 
     @staticmethod
@@ -53,6 +60,7 @@ class TransformerConfig:
         return TransformerConfig(
             vocab_size=vocab_size, max_seq_len=max_seq_len,
             dim=768, num_layers=12, num_heads=12, dropout=0.1,
+            activation_dtype="bfloat16",
         )
 
 
@@ -168,6 +176,8 @@ class TransformerLM(Model):
 
         x = jnp.take(p["wte"]["table"], tokens, axis=0)
         x = x + p["wpe"]["table"][:t]
+        if self.config.activation_dtype is not None:
+            x = x.astype(self.config.activation_dtype)
         if self.drop is not None:
             x, _ = self.drop.apply(
                 {"params": {}, "state": {}}, x, mode=mode,

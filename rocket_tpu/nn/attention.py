@@ -130,16 +130,19 @@ class MultiHeadAttention(Layer):
         qkv, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
 
-        q, k, v = (
-            jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
-        )  # each (B, H, T, D)
-
         impl = resolve_impl(self.impl, t, self.head_dim)
         if impl == "flash":
-            from rocket_tpu.ops.flash_attention import flash_attention
+            from rocket_tpu.ops.flash_attention import flash_attention_qkv
 
-            out = flash_attention(q, k, v, causal=self.causal)
+            # One stacked (3, B, H, T, D) operand: a single layout copy in
+            # and out of the kernel (see ops/flash_attention.py).
+            out = flash_attention_qkv(
+                jnp.transpose(qkv, (2, 0, 3, 1, 4)), causal=self.causal
+            )
         else:
+            q, k, v = (
+                jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
+            )  # each (B, H, T, D)
             out = dot_product_attention(q, k, v, causal=self.causal)
         out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)
 

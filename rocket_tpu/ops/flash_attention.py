@@ -1,4 +1,4 @@
-"""Flash attention — pallas TPU kernel (fwd + bwd, causal or full).
+"""Flash attention — pallas TPU kernel (fwd + fused bwd, causal or full).
 
 Blockwise online-softmax attention that never materializes the (T, T) score
 matrix: per query block, KV blocks stream through VMEM while running max /
@@ -12,19 +12,29 @@ counterpart of what torch users get from ``F.scaled_dot_product_attention``.
 
 Performance notes (what the profiler said, and what this design does):
 
-* operands are (B, H, T, D) — mosaic requires the last two block dims to
-  tile (8, 128) or equal the array dims, which rules out slicing a
-  middle-position head axis;
+* q, k and v travel as ONE stacked (3, B, H, T, D) array (three block specs
+  index into the same operand). Pallas custom calls pin their operands to
+  the default layout, so every separate operand costs a physical
+  layout-conversion copy per layer — the stacked form needs exactly one
+  bf16 copy in and one out, where three separate operands cost six (and
+  XLA was materializing two of them in f32);
+* the backward is ONE kernel pass: s2 and the softmax reconstruction are
+  computed once and shared by the dv / dk / dq products (the classic
+  two-kernel split recomputes them twice). dk/dv accumulate in f32 scratch
+  across the query sweep; dq is written as per-kv-block partials (input
+  dtype) and summed by one cheap XLA add outside. The partial buffer is
+  O(nk) times dq — fine at trained context lengths (nk = T/512); very long
+  single-device sequences should shard T instead (parallel/ring_attention);
 * at GPT-2's D=64, one elementwise pass over a (bq, bk) score block costs
   as much VPU time as the whole QK^T matmul costs MXU time, so VPU passes
-  are minimized: causal masking runs **only on diagonal blocks** (fully
-  masked blocks are skipped, interior blocks take a mask-free path), and
-  the softmax works in base-2 (``exp2``) so the scale folds into one fma;
+  are minimized: causal masking runs only on diagonal blocks (fully masked
+  blocks are skipped, interior blocks take a mask-free path), and the
+  softmax works in base-2 (``exp2``) so the scale folds into one fma;
 * all matmuls declare ``preferred_element_type=jnp.float32``; softmax
   statistics and accumulators stay f32 while operands stay bf16;
 * TPU grids iterate sequentially with the last axis innermost, so f32
-  scratch carries across the kv sweep and outputs flush on the last visit
-  (see /opt/skills/guides/pallas_guide.md).
+  scratch carries across the inner sweep and outputs flush on the last
+  visit (see /opt/skills/guides/pallas_guide.md).
 
 On non-TPU backends (the virtual-CPU test mesh) the kernels run in pallas
 interpret mode, so the same code path is unit-testable without a chip.
@@ -41,7 +51,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_qkv", "pick_block"]
 
 _NEG_INF = -1e30
 _LOG2E = math.log2(math.e)
@@ -63,10 +73,13 @@ def pick_block(t: int, preferred: int = 512) -> Optional[int]:
     return None
 
 
-def _causal_mask(s, block_q: int, block_k: int):
-    """Lower-triangular mask for an aligned diagonal block."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+def _causal_mask(s):
+    """Lower-triangular mask for an aligned diagonal block.
+
+    ``s`` is (hb, block_q, block_k) — the mask broadcasts over the
+    head-batch dim."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 2)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 1)
     return jnp.where(rows >= cols, s, _NEG_INF)
 
 
@@ -90,22 +103,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
     # causal). Interior blocks run mask-free; blocks above the diagonal are
     # skipped entirely.
     def tile(masked: bool):
-        q = q_ref[0, 0]
+        q = q_ref[0, 0]  # (hb, bq, d)
         k = k_ref[0, 0]
         # s2 = (q . k) * scale * log2(e): base-2 domain, scale folded in.
         s2 = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale2  # (block_q, block_k)
+        ) * scale2  # (hb, block_q, block_k)
         if masked:
-            s2 = _causal_mask(s2, block_q, block_k)
+            s2 = _causal_mask(s2)
         m_prev = m_s[:]
         m_new = jnp.maximum(m_prev, jnp.max(s2, axis=-1, keepdims=True))
         p = jnp.exp2(s2 - m_new)
         alpha = jnp.exp2(m_prev - m_new)
         l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0, 0], (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         acc[:] = acc[:] * alpha + pv
@@ -126,16 +139,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
     def _flush():
         l = l_s[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
         # lse kept in the base-2 domain: lse2 = m2 + log2(l).
-        lse_ref[0, 0] = m_s[:] + jnp.log2(safe_l)
+        lse_ref[0] = m_s[:] + jnp.log2(safe_l)
 
 
-def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
-    b, h, t, d = q.shape
-    tk = k.shape[2]
+def _head_block(h: int) -> int:
+    """Heads processed per grid step — halves the per-step grid overhead
+    (the dominant cost at D=64 block sizes) when the head count allows."""
+    return 2 if h % 2 == 0 else 1
+
+
+def _fwd(qkv, *, causal, block_q, block_k, interpret):
+    _, b, h, t, d = qkv.shape
     scale2 = _LOG2E / math.sqrt(d)
-    nq, nk = t // block_q, tk // block_k
+    nq, nk = t // block_q, t // block_k
+    hb = _head_block(h)
+
+    def qs(i):
+        return pl.BlockSpec(
+            (1, 1, hb, block_q, d), lambda b, h, iq, ik, i=i: (i, b, h, iq, 0)
+        )
+
+    def ks(i):
+        return pl.BlockSpec(
+            (1, 1, hb, block_k, d), lambda b, h, iq, ik, i=i: (i, b, h, ik, 0)
+        )
 
     kernel = functools.partial(
         _fwd_kernel, scale2=scale2, causal=causal,
@@ -143,83 +172,37 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
-        ],
+        grid=(b, h // hb, nq, nk),
+        in_specs=[qs(0), ks(1), ks(2)],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), qkv.dtype),
             jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((hb, block_q, d), jnp.float32),
+            pltpu.VMEM((hb, block_q, 1), jnp.float32),
+            pltpu.VMEM((hb, block_q, 1), jnp.float32),
         ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
-    )(q, k, v)
+    )(qkv, qkv, qkv)
     return out, lse
 
 
 # --------------------------------------------------------------------------
-# backward
+# backward — one fused pass
 # --------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, scale2, causal, block_q, block_k):
-    iq, ik = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(ik == 0)
-    def _init():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
-
-    def tile(masked: bool):
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        s2 = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale2
-        if masked:
-            s2 = _causal_mask(s2, block_q, block_k)
-        p = jnp.exp2(s2 - lse_ref[0, 0])
-        dp = jax.lax.dot_general(
-            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0, 0]) * scale
-        dq_acc[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    if causal:
-        @pl.when(ik < iq)
-        def _interior():
-            tile(masked=False)
-
-        @pl.when(ik == iq)
-        def _diagonal():
-            tile(masked=True)
-    else:
-        tile(masked=False)
-
-    @pl.when(ik == nk - 1)
-    def _flush():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, scale2, causal, block_q, block_k):
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, scale2, causal, block_q, block_k):
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -229,29 +212,35 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def tile(masked: bool):
-        q = q_ref[0, 0]
+        q = q_ref[0, 0]  # (hb, bq, d)
         k = k_ref[0, 0]
         s2 = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale2
+        ) * scale2  # (hb, bq, bk)
         if masked:
-            s2 = _causal_mask(s2, block_q, block_k)
-        p = jnp.exp2(s2 - lse_ref[0, 0])  # (bq, bk)
-        do = do_ref[0, 0]
+            s2 = _causal_mask(s2)
+        p = jnp.exp2(s2 - lse_ref[0])
+        do = do_ref[0]  # (hb, bq, d)
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # (bk, d)
+        )  # (hb, bk, d)
         dp = jax.lax.dot_general(
-            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            do, v_ref[0, 0], (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # (bq, bk)
-        ds = p * (dp - delta_ref[0, 0]) * scale
+        )  # (hb, bq, bk)
+        ds = p * (dp - delta_ref[0]) * scale
+        ds_c = ds.astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds_c, q, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # (bk, d)
+        )  # (hb, bk, d)
+        # This kv block's contribution to dq — summed over blocks outside.
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds_c, k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).astype(dqp_ref.dtype)  # (hb, bq, d)
 
     if causal:
         @pl.when(ik < iq)
@@ -261,22 +250,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         @pl.when(ik == iq)
         def _diagonal():
             tile(masked=True)
+
+        @pl.when(ik > iq)
+        def _skipped():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
     else:
         tile(masked=False)
 
     @pl.when(iq == nq - 1)
     def _flush():
-        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, dout):
-    q, k, v, out, lse = res
-    b, h, t, d = q.shape
-    tk = k.shape[2]
+    qkv, out, lse = res
+    _, b, h, t, d = qkv.shape
     scale = 1.0 / math.sqrt(d)
     scale2 = _LOG2E / math.sqrt(d)
-    nq, nk = t // block_q, tk // block_k
+    nq, nk = t // block_q, t // block_k
 
     # delta = rowsum(dout * out), column layout (B, H, T, 1) to match lse.
     delta = jnp.sum(
@@ -284,84 +276,121 @@ def _bwd(causal, block_q, block_k, interpret, res, dout):
         keepdims=True,
     )  # (B, H, T, 1)
 
-    common = dict(scale=scale, scale2=scale2, causal=causal,
-                  block_q=block_q, block_k=block_k)
+    hb = _head_block(h)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)
+    def qs(i):
+        return pl.BlockSpec(
+            (1, 1, hb, block_q, d), lambda b, h, ik, iq, i=i: (i, b, h, iq, 0)
+        )
+
+    def ks(i):
+        return pl.BlockSpec(
+            (1, 1, hb, block_k, d), lambda b, h, ik, iq, i=i: (i, b, h, ik, 0)
+        )
+
+    dq_part, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel, scale=scale, scale2=scale2, causal=causal,
+            block_q=block_q, block_k=block_k,
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, dout, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(b, h, nk, nq),
+        grid=(b, h // hb, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+            qs(0), ks(1), ks(2),
+            pl.BlockSpec((1, hb, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec(
+                (1, 1, hb, block_q, d), lambda b, h, ik, iq: (ik, b, h, iq, 0)
+            ),
+            pl.BlockSpec((1, hb, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, hb, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((nk, b, h, t, d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), qkv.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((hb, block_k, d), jnp.float32),
+            pltpu.VMEM((hb, block_k, d), jnp.float32),
         ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(qkv, qkv, qkv, dout, lse, delta)
 
-    return dq, dk, dv
+    dq = dq_part[0] if nk == 1 else jnp.sum(
+        dq_part.astype(jnp.float32), axis=0
+    ).astype(qkv.dtype)
+    return (jnp.stack([dq, dk, dv]),)
 
 
 # --------------------------------------------------------------------------
-# public op
+# public ops
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash(qkv, causal, block_q, block_k, interpret):
     out, _ = _fwd(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        qkv, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(qkv, causal, block_q, block_k, interpret):
     out, lse = _fwd(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        qkv, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v, out, lse)
+    return out, (qkv, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
-    return _bwd(causal, block_q, block_k, interpret, res, dout)
+_flash.defvjp(_flash_fwd, _bwd)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+def _resolve_blocks(t: int, causal: bool, block_q: int, block_k: int):
+    bq = pick_block(t, min(block_q, t))
+    bk = pick_block(t, min(block_k, t))
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention: seq len {t} must be a multiple of a "
+            "supported block size (128); use the XLA path for ragged shapes."
+        )
+    if causal:
+        # Diagonal-block masking assumes aligned square blocks.
+        bq = bk = min(bq, bk)
+    return bq, bk
+
+
+def flash_attention_qkv(
+    qkv: jax.Array,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention on a stacked (3, B, H, T, D) q/k/v array.
+
+    The stacked form is the fast path: pallas pins operand layouts, so one
+    stacked operand costs one layout copy where three separate ones cost
+    six. Returns (B, H, T, D). Differentiable (custom VJP, fused one-pass
+    backward).
+    """
+    if qkv.ndim != 5 or qkv.shape[0] != 3:
+        raise ValueError(
+            f"flash_attention_qkv: expected stacked (3, B, H, T, D), got "
+            f"{qkv.shape}; for separate q/k/v use flash_attention()."
+        )
+    t = qkv.shape[3]
+    block_q, block_k = _resolve_blocks(t, causal, block_q, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash(qkv, causal, block_q, block_k, interpret)
 
 
 def flash_attention(
@@ -375,26 +404,20 @@ def flash_attention(
 ) -> jax.Array:
     """Blockwise (flash) attention for (B, H, T, D) operands.
 
-    Differentiable (custom VJP with the standard recomputation backward).
-    ``T`` must be divisible by the block sizes (callers fall back to the XLA
-    path otherwise — see ``nn/attention.py``); causal additionally requires
-    square aligned blocks. Softmax statistics and all accumulators are f32.
+    Differentiable (custom VJP with a fused one-pass recomputation
+    backward). ``T`` must be a multiple of a supported block size (the
+    caller falls back to the XLA path otherwise — see ``nn/attention.py``);
+    causal requires t_q == t_kv. Softmax statistics and all accumulators
+    are float32 regardless of input dtype.
     """
-    t = q.shape[2]
-    tk = k.shape[2]
-    if causal and t != tk:
+    if causal and q.shape[2] != k.shape[2]:
         raise ValueError("flash_attention: causal requires t_q == t_kv.")
-    bq = pick_block(t, min(block_q, t))
-    bk = pick_block(tk, min(block_k, tk))
-    if bq is None or bk is None:
+    if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(
-            f"flash_attention: seq lens ({t}, {tk}) must be multiples of a "
-            "supported block size (128); use the XLA path for ragged shapes."
+            "flash_attention: q, k, v must share one shape (cross-attention "
+            "with t_q != t_kv goes through the XLA path)."
         )
-    if causal:
-        # Diagonal-block masking assumes aligned square blocks.
-        bq = bk = min(bq, bk)
-    block_q, block_k = bq, bk
-    if interpret is None:
-        interpret = _interpret_default()
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return flash_attention_qkv(
+        jnp.stack([q, k, v]), causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
