@@ -1,20 +1,31 @@
-"""Benchmark: MNIST-MLP training throughput through the full capsule stack.
+"""Benchmark suite: every BASELINE.json north-star config, one JSON line.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+Configs (driver contract: stdout carries exactly ONE JSON line; progress
+goes to stderr):
 
-Baseline: the same 784-512-256-10 MLP, batch 1024, SGD, trained with
-torch-CPU (BASELINE.json configs[0] "single-device CPU ref"), measured on
-this host at 35768 samples/sec — see BASELINE.md. ``vs_baseline`` is the
-ratio of this framework's per-chip throughput to that number.
+* ``gpt2``       — GPT-2 124M, B=8, T=1024, bf16, flash attention, AdamW
+                   (BASELINE.json configs[4], single chip). THE headline
+                   metric: tok/sec/chip + MFU.
+* ``charlm``     — TinyShakespeare char-transformer, B=128, T=256
+                   (configs[2]): tok/sec/chip + MFU.
+* ``resnet18``   — CIFAR-10 ResNet-18, B=256 (configs[1]): samples/sec/chip.
+* ``mlp``        — MNIST MLP, B=1024 (configs[0], round-1 continuity):
+                   samples/sec/chip vs the torch-CPU measurement.
 
-Run on whatever ``jax.devices()`` exposes (the driver runs it on one real TPU
-chip); all devices are put on a data-parallel mesh axis and throughput is
-normalized per chip.
+Every config drives the FULL capsule stack (Launcher/Looper/Dataset/Module)
+— framework overhead is part of the number. Timing syncs with a real host
+fetch: ``jax.block_until_ready`` is a no-op through this environment's
+device tunnel, so the timer capsule fetches a device scalar at the start
+and end of the measured window.
+
+``vs_baseline`` on the headline line is GPT-2 throughput vs the round-1
+measurement of this same framework (53.9k tok/s — the reference publishes
+no numbers at all, see BASELINE.md), i.e. the round-over-round speedup.
 """
 
 import argparse
 import json
+import sys
 import time
 
 import jax
@@ -24,19 +35,63 @@ import optax
 import rocket_tpu as rt
 from rocket_tpu import optim
 from rocket_tpu.data.datasets import ArrayDataset
+from rocket_tpu.data.text import TokenDataset, synthetic_corpus, CharTokenizer
 from rocket_tpu.models.mlp import MLP
+from rocket_tpu.models.resnet import resnet18
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    next_token_loss,
+)
 
-TORCH_CPU_BASELINE_SAMPLES_PER_SEC = 35768.0
+TORCH_CPU_MLP_BASELINE = 35768.0      # samples/sec, measured on this host (r1)
+ROUND1_GPT2_TOKS = 53900.0            # tok/sec/chip, judge-measured round 1
+
+#: bf16 peak by device kind — MFU denominators.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+}
 
 
-def cross_entropy(batch):
+def peak_flops():
+    """bf16 peak for the local device kind, or None when unknown (MFU is
+    then omitted rather than silently computed against the wrong peak)."""
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    log(f"bench: unknown device kind {kind!r} — omitting MFU")
+    return None
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def cross_entropy(b):
     return optax.softmax_cross_entropy_with_integer_labels(
-        batch["logits"], batch["label"]
+        b["logits"], b["label"]
     ).mean()
 
 
+def _class_dataset(shape, batch, warmup, steps, num_classes=10):
+    rng = np.random.default_rng(0)
+    total = batch * (warmup + steps)
+    return ArrayDataset(
+        rng.normal(size=(total, *shape)).astype(np.float32),
+        rng.integers(0, num_classes, size=total).astype(np.int32),
+    )
+
+
 class Timer(rt.Capsule):
-    """Starts the clock after `warmup` steps (past compile), device-synced."""
+    """Measures steady-state step time with true device syncs.
+
+    Starts the clock after ``warmup`` steps (past compile), syncing via a
+    host fetch of the module's device step counter; the caller closes the
+    window with :meth:`stop` after the run.
+    """
 
     def __init__(self, module, warmup: int):
         super().__init__(priority=50)  # after all work capsules
@@ -47,65 +102,169 @@ class Timer(rt.Capsule):
 
     def launch(self, attrs=None):
         self.count += 1
-        self.last_params = self._module.state["params"]
+        # Keep a handle on the live device step counter: the launcher's
+        # destroy pass clears the module before stop() runs.
+        self._last_step = self._module.state["step"]
+        if self.count == 1:
+            self.n_params = sum(
+                int(l.size) for l in jax.tree.leaves(self._module.state["params"])
+            )
         if self.count == self._warmup:
-            jax.block_until_ready(self.last_params)
+            int(np.asarray(self._last_step))  # true device sync
             self.t0 = time.perf_counter()
 
+    def stop(self) -> float:
+        int(np.asarray(self._last_step))
+        return time.perf_counter() - self.t0
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--batch", type=int, default=1024)
-    parser.add_argument("--warmup", type=int, default=10)
-    parser.add_argument("--steps", type=int, default=60)
-    args = parser.parse_args()
 
+def _train(capsules, runtime, timer):
+    launcher = rt.Launcher(
+        [rt.Looper(capsules + [timer], tag="train", progress=False)],
+        num_epochs=1,
+        runtime=runtime,
+    )
+    launcher.launch()
+    return timer.stop()
+
+
+def bench_mlp(warmup=10, steps=60, batch=1024):
     n_dev = len(jax.devices())
     runtime = rt.Runtime(seed=0)
-
-    total = args.batch * (args.warmup + args.steps)
-    rng = np.random.default_rng(0)
-    data = ArrayDataset(
-        rng.normal(size=(total, 784)).astype(np.float32),
-        rng.integers(0, 10, size=total).astype(np.int32),
-    )
-
+    data = _class_dataset((784,), batch, warmup, steps)
     model = MLP(in_features=784, num_classes=10, hidden=(512, 256))
     module = rt.Module(
         model,
         capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.sgd(), learning_rate=0.01)],
     )
-    timer = Timer(module, warmup=args.warmup)
-    launcher = rt.Launcher(
-        [
-            rt.Looper(
-                [rt.Dataset(data, batch_size=args.batch), module, timer],
-                tag="train",
-                progress=False,
-            )
+    timer = Timer(module, warmup)
+    elapsed = _train(
+        [rt.Dataset(data, batch_size=batch), module], runtime, timer
+    )
+    per_chip = batch * steps / elapsed / n_dev
+    return {
+        "metric": "mnist_mlp_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / TORCH_CPU_MLP_BASELINE, 3),
+    }
+
+
+def bench_resnet18(warmup=5, steps=30, batch=256):
+    import jax.numpy as jnp
+
+    n_dev = len(jax.devices())
+    runtime = rt.Runtime(seed=0)
+    data = _class_dataset((32, 32, 3), batch, warmup, steps)
+    model = resnet18(num_classes=10, stem="cifar")
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(cross_entropy),
+            rt.Optimizer(optim.momentum(beta=0.9), learning_rate=0.1),
         ],
-        num_epochs=1,
-        runtime=runtime,
+        compute_dtype=jnp.bfloat16,
     )
-
-    launcher.launch()
-
-    jax.block_until_ready(timer.last_params)
-    t1 = time.perf_counter()
-    elapsed = t1 - timer.t0
-    measured_samples = args.batch * args.steps
-    per_chip = measured_samples / elapsed / n_dev
-
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_mlp_train_samples_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(per_chip / TORCH_CPU_BASELINE_SAMPLES_PER_SEC, 3),
-            }
-        )
+    timer = Timer(module, warmup)
+    elapsed = _train(
+        [rt.Dataset(data, batch_size=batch, drop_last=True), module],
+        runtime, timer,
     )
+    per_chip = batch * steps / elapsed / n_dev
+    return {
+        "metric": "cifar_resnet18_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+    }
+
+
+def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):
+    import jax.numpy as jnp
+
+    n_dev = len(jax.devices())
+    runtime = rt.Runtime(seed=0)
+    seq = config.max_seq_len
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(
+        0, config.vocab_size, size=seq * (batch * (warmup + steps) + 1)
+    ).astype(np.int32)
+    data = TokenDataset(tokens, seq_len=seq)
+    model = TransformerLM(config)
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(next_token_loss()),
+            rt.Optimizer(optim.adamw(), learning_rate=lr),
+        ],
+        compute_dtype=jnp.bfloat16,
+    )
+    timer = Timer(module, warmup)
+    elapsed = _train(
+        [rt.Dataset(data, batch_size=batch, drop_last=True), module],
+        runtime, timer,
+    )
+    tok_per_chip = batch * seq * steps / elapsed / n_dev
+    flops_per_tok = 6 * timer.n_params + 12 * config.num_layers * seq * config.dim
+    out = {
+        "metric": f"{name}_tok_per_sec_per_chip",
+        "value": round(tok_per_chip, 1),
+        "unit": "tok/sec/chip",
+    }
+    peak = peak_flops()
+    if peak is not None:
+        out["mfu"] = round(tok_per_chip * flops_per_tok / peak, 4)
+    return out
+
+
+def bench_charlm(warmup=5, steps=40):
+    tok = CharTokenizer(synthetic_corpus(10_000))
+    config = TransformerConfig.char_lm(vocab_size=tok.vocab_size, max_seq_len=256)
+    config.dropout = 0.0
+    return _bench_lm(config, batch=128, warmup=warmup, steps=steps, name="charlm")
+
+
+def bench_gpt2(warmup=5, steps=30):
+    config = TransformerConfig.gpt2_124m()
+    config.dropout = 0.0
+    out = _bench_lm(config, batch=8, warmup=warmup, steps=steps, name="gpt2_124m")
+    out["vs_baseline"] = round(out["value"] / ROUND1_GPT2_TOKS, 3)
+    return out
+
+
+BENCHES = {
+    "gpt2": bench_gpt2,
+    "charlm": bench_charlm,
+    "resnet18": bench_resnet18,
+    "mlp": bench_mlp,
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--config", default="all", choices=["all", *BENCHES.keys()]
+    )
+    args = parser.parse_args()
+
+    names = list(BENCHES) if args.config == "all" else [args.config]
+    results = {}
+    for name in names:
+        log(f"bench: {name} ...")
+        t0 = time.time()
+        try:
+            results[name] = BENCHES[name]()
+            log(f"bench: {name} -> {results[name]} ({time.time()-t0:.0f}s)")
+        except Exception as exc:  # noqa: BLE001 — record, keep benching
+            log(f"bench: {name} FAILED: {exc!r}")
+            results[name] = {"metric": name, "error": str(exc)}
+
+    ok = {n: r for n, r in results.items() if "error" not in r}
+    headline = ok.get("gpt2") or next(iter(ok.values()), None) \
+        or next(iter(results.values()))
+    line = dict(headline)
+    line["extra"] = {n: r for n, r in results.items()
+                     if r.get("metric") != headline.get("metric")}
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

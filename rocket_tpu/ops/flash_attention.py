@@ -89,7 +89,7 @@ def _causal_mask(s):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
-                scale2, causal, block_q, block_k):
+                scale2, causal):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -166,10 +166,7 @@ def _fwd(qkv, *, causal, block_q, block_k, interpret):
             (1, 1, hb, block_k, d), lambda b, h, iq, ik, i=i: (i, b, h, ik, 0)
         )
 
-    kernel = functools.partial(
-        _fwd_kernel, scale2=scale2, causal=causal,
-        block_q=block_q, block_k=block_k,
-    )
+    kernel = functools.partial(_fwd_kernel, scale2=scale2, causal=causal)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h // hb, nq, nk),
@@ -202,7 +199,7 @@ def _fwd(qkv, *, causal, block_q, block_k, interpret):
 
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                scale, scale2, causal, block_q, block_k):
+                scale, scale2, causal):
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -289,10 +286,7 @@ def _bwd(causal, block_q, block_k, interpret, res, dout):
         )
 
     dq_part, dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_kernel, scale=scale, scale2=scale2, causal=causal,
-            block_q=block_q, block_k=block_k,
-        ),
+        functools.partial(_bwd_kernel, scale=scale, scale2=scale2, causal=causal),
         grid=(b, h // hb, nk, nq),
         in_specs=[
             qs(0), ks(1), ks(2),
