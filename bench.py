@@ -47,23 +47,15 @@ from rocket_tpu.models.transformer import (
 TORCH_CPU_MLP_BASELINE = 35768.0      # samples/sec, measured on this host (r1)
 ROUND1_GPT2_TOKS = 53900.0            # tok/sec/chip, judge-measured round 1
 
-#: bf16 peak by device kind — MFU denominators.
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,
-    "TPU v5": 459e12,
-    "TPU v4": 275e12,
-}
-
-
 def peak_flops():
     """bf16 peak for the local device kind, or None when unknown (MFU is
     then omitted rather than silently computed against the wrong peak)."""
-    kind = jax.devices()[0].device_kind
-    for prefix, peak in PEAK_FLOPS.items():
-        if kind.startswith(prefix):
-            return peak
-    log(f"bench: unknown device kind {kind!r} — omitting MFU")
-    return None
+    from rocket_tpu.utils.perf import peak_flops as _peak
+
+    peak = _peak()
+    if peak is None:
+        log(f"bench: unknown device kind {jax.devices()[0].device_kind!r} — omitting MFU")
+    return peak
 
 
 def log(msg: str) -> None:

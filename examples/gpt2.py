@@ -39,6 +39,10 @@ def main():
     parser.add_argument("--accum", type=int, default=1)
     parser.add_argument("--epochs", type=int, default=1)
     parser.add_argument("--small", action="store_true", help="tiny dims for smoke runs")
+    parser.add_argument(
+        "--trace-at", type=int, default=None,
+        help="capture a jax.profiler trace for 3 steps starting here",
+    )
     args = parser.parse_args()
 
     n_dev = len(jax.devices())
@@ -57,6 +61,12 @@ def main():
     else:
         config = TransformerConfig.gpt2_124m(max_seq_len=args.seq_len)
     model = TransformerLM(config)
+    # Analytic param count (embeddings + 12d^2 per block) — MFU denominator.
+    n_params = (
+        config.vocab_size * config.dim
+        + config.max_seq_len * config.dim
+        + config.num_layers * 12 * config.dim * config.dim
+    )
 
     # Corpus: byte-level over the synthetic text (stands in for the real
     # tokenized corpus; swap TokenDataset input for production data).
@@ -89,6 +99,12 @@ def main():
                     ),
                     rt.Checkpointer(output_dir="checkpoints/gpt2", save_every=1000,
                                     keep_last=3),
+                    # steps/sec + MFU in the tqdm postfix; optional trace.
+                    rt.Profiler(
+                        trace_start=args.trace_at,
+                        flops_per_sample=6.0 * n_params * args.seq_len
+                        + 12.0 * config.num_layers * config.dim * args.seq_len**2,
+                    ),
                     rt.Tracker(backend="jsonl", project="gpt2"),
                 ],
                 tag="train",

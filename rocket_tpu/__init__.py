@@ -21,6 +21,7 @@ from rocket_tpu.core import (
     Metric,
     Module,
     Optimizer,
+    Profiler,
     Scheduler,
     Tracker,
 )
@@ -42,6 +43,7 @@ __all__ = [
     "Metric",
     "Module",
     "Optimizer",
+    "Profiler",
     "Runtime",
     "Scheduler",
     "Tracker",

@@ -12,6 +12,7 @@ from rocket_tpu.core.loss import Loss
 from rocket_tpu.core.meter import Meter, Metric
 from rocket_tpu.core.module import Module
 from rocket_tpu.core.optimizer import Optimizer
+from rocket_tpu.core.profiler import Profiler
 from rocket_tpu.core.scheduler import Scheduler
 from rocket_tpu.core.tracker import Tracker
 
@@ -29,6 +30,7 @@ __all__ = [
     "Metric",
     "Module",
     "Optimizer",
+    "Profiler",
     "Scheduler",
     "Tracker",
 ]

@@ -16,14 +16,25 @@ barrier is rank-0-only and non-main ranks never save (``checkpoint.py:53-63``)
 (the writer is main-process-gated inside, the barrier is global).
 
 Layout per step (analogue of the reference's verified layout, SURVEY §3.3):
-``<output_dir>/<iter_idx>/model_{k}.pkl`` (one TrainState pytree per prepared
-model — params, optimizer moments, model state, PRNG base key, step),
-``capsules.pkl`` (the stateful-capsule stack states, in setup order) and
-``rng.pkl`` (runtime key counter).
+``<output_dir>/<iter_idx>/model_{k}/`` (one sharded TrainState directory per
+prepared model — params, optimizer moments, model state, PRNG base key, step;
+``shard_p{process}.npz`` per host + ``index.json``), ``capsules.pkl`` (the
+stateful-capsule stack states, in setup order) and ``rng.json`` (runtime key
+counter).
+
+Saves are **non-blocking**: the device→host pull is synchronous (donated
+buffers stay safe), the file writes overlap training on a background thread
+(``checkpoint_io.AsyncWriter``); ``destroy`` drains the queue.
+
+Trust boundary: model state is pickle-free (npz + json); ``capsules.pkl`` IS
+pickle and must only be resumed from checkpoints you wrote — it carries
+host-side Python capsule state, the analogue of accelerate's
+``custom_checkpoint_{N}.pkl``.
 """
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -54,6 +65,7 @@ class Checkpointer(Capsule):
         self._keep_last = keep_last
         self._iter_idx = 0
         self._saved_steps: list[int] = []
+        self._writer = checkpoint_io.AsyncWriter()
 
     # -- events ------------------------------------------------------------
 
@@ -71,51 +83,76 @@ class Checkpointer(Capsule):
     # -- save --------------------------------------------------------------
 
     def save(self, step: Optional[int] = None) -> str:
-        """Write one checkpoint directory; returns its path."""
+        """Write one checkpoint directory; returns its path.
+
+        ALL processes run the whole path (fixes the reference's rank-0-only
+        barrier, ``checkpoint.py:53-63``): each host snapshots and writes only
+        the array chunks it owns — nothing is gathered. The snapshot
+        (device→host pull) is synchronous; the file writes run on a
+        background thread, drained by the next save / :meth:`destroy`.
+        """
         runtime = self._runtime
         step = self._iter_idx if step is None else step
         path = os.path.join(self._output_dir, str(step))
 
-        # ALL processes reach the barrier (fixes checkpoint.py:53-63) and run
-        # the materialize phase — cross-host-sharded arrays are gathered with
-        # a collective, so every rank must participate; only the main process
-        # writes the files.
+        # Backpressure: at most one write in flight, and the previous step's
+        # files are complete before this one starts (keep_last can prune
+        # safely below).
+        self._writer.wait()
         # Record this step BEFORE snapshotting capsule states so the
         # checkpoint's own entry survives a resume and gets pruned later.
         self._saved_steps.append(step)
 
         runtime.wait_for_everyone()
-        model_states = [
-            checkpoint_io.materialize_pytree(prepared.state)
+        plans = [
+            checkpoint_io.snapshot(prepared.state)
             for prepared in runtime.models.values()
         ]
+        capsule_states = None
+        rng_state = None
         if runtime.is_main_process:
-            import pickle
-
-            os.makedirs(path, exist_ok=True)
-            for k, host_state in enumerate(model_states):
-                checkpoint_io.atomic_write(
-                    os.path.join(path, f"model_{k}.pkl"),
-                    pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL),
-                )
             capsule_states = [obj.state_dict() for obj in runtime.checkpoint_stack]
-            checkpoint_io.atomic_write(
-                os.path.join(path, "capsules.pkl"), pickle.dumps(capsule_states)
-            )
-            checkpoint_io.save_pytree(
-                os.path.join(path, "rng.pkl"), runtime.rng_state_dict()
-            )
-        runtime.wait_for_everyone()
+            rng_state = runtime.rng_state_dict()
 
-        if self._keep_last is not None and runtime.is_main_process:
+        # Pruning happens INSIDE the write job, after this step is fully on
+        # disk — pruning eagerly would leave a window with zero restorable
+        # checkpoints if the process dies mid-write.
+        prune = []
+        if self._keep_last is not None:
             while len(self._saved_steps) > self._keep_last:
                 old = self._saved_steps.pop(0)
-                old_path = os.path.join(self._output_dir, str(old))
-                import shutil
+                if runtime.is_main_process:
+                    prune.append(os.path.join(self._output_dir, str(old)))
 
+        def write():
+            for k, plan in enumerate(plans):
+                checkpoint_io.write_snapshot(os.path.join(path, f"model_{k}"), plan)
+            if capsule_states is not None:
+                import pickle
+
+                checkpoint_io.atomic_write(
+                    os.path.join(path, "capsules.pkl"), pickle.dumps(capsule_states)
+                )
+                checkpoint_io.atomic_write(
+                    os.path.join(path, "rng.json"),
+                    json.dumps(rng_state).encode("utf-8"),
+                )
+            import shutil
+
+            for old_path in prune:
                 shutil.rmtree(old_path, ignore_errors=True)
-        self.log_info(f"saved checkpoint at {path}")
+
+        self._writer.submit(write)
+        self.log_info(f"saving checkpoint at {path} (async)")
         return path
+
+    def destroy(self, attrs: Attributes | None = None) -> None:
+        """Drain the async writer, then the usual teardown; the trailing
+        barrier guarantees every host's shards exist before anyone resumes."""
+        self._writer.wait()
+        if self._runtime is not None:
+            self._runtime.wait_for_everyone()
+        super().destroy(attrs)
 
     # -- restore -----------------------------------------------------------
 
@@ -125,15 +162,28 @@ class Checkpointer(Capsule):
             raise RuntimeError(f"Checkpointer: resume_from {path!r} does not exist.")
 
         for k, prepared in enumerate(runtime.models.values()):
-            model_path = os.path.join(path, f"model_{k}.pkl")
-            if os.path.exists(model_path):
+            model_path = os.path.join(path, f"model_{k}")
+            if os.path.isdir(model_path):
                 prepared.state = checkpoint_io.load_pytree(
                     model_path, template=prepared.state
                 )
+            elif os.path.exists(model_path + ".pkl"):
+                raise RuntimeError(
+                    f"Checkpointer: {model_path}.pkl is a pre-0.2 pickle "
+                    "checkpoint; the sharded npz layout cannot read it. "
+                    "Re-save with the current version."
+                )
+            else:
+                # Resuming without model state is almost never intended.
+                self.log_warning(
+                    f"checkpoint {path} has no model_{k} — model state NOT "
+                    "restored."
+                )
 
-        rng_path = os.path.join(path, "rng.pkl")
+        rng_path = os.path.join(path, "rng.json")
         if os.path.exists(rng_path):
-            runtime.load_rng_state_dict(checkpoint_io.load_pytree(rng_path))
+            with open(rng_path, "r", encoding="utf-8") as f:
+                runtime.load_rng_state_dict(json.load(f))
 
         if self._resume_capsules:
             capsule_path = os.path.join(path, "capsules.pkl")

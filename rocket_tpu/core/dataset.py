@@ -45,6 +45,7 @@ class Dataset(Capsule):
         collate_fn: Optional[Callable] = None,
         device_placement: Optional[bool] = None,
         device_cache: str | bool = "auto",
+        prefetch: int = 2,
         statefull: bool = True,
         priority: int = 1000,
         runtime=None,
@@ -58,11 +59,16 @@ class Dataset(Capsule):
             collate_fn=collate_fn,
         )
         self._device_placement = device_placement
+        # Streaming-path lookahead: collate + H2D run on a worker thread,
+        # `prefetch` batches deep (0 disables). The device-resident cache
+        # path doesn't need it (no per-step H2D at all).
+        self._prefetch = int(prefetch)
         # Device-resident cache: "auto" caches map-style datasets that fit
         # the runtime's HBM budget, eliminating per-step H2D traffic (the
         # dominant cost on TPU for small datasets — see data/device_cache.py).
         self._device_cache = device_cache
         self._device_resident = False
+        self._prefetched_placement = False
         self._dataloader: Optional[DataLoader] = None
         self._iterator = None
         self._total: Optional[int] = None
@@ -164,7 +170,26 @@ class Dataset(Capsule):
         if self._batch_idx > 0 and (attrs is None or attrs.mode == "train"):
             self._dataloader.skip(self._batch_idx)
         self._total = self._dataloader.total
-        self._iterator = iter(self._dataloader)
+        self._close_iterator()
+        iterator = iter(self._dataloader)
+        self._prefetched_placement = False
+        if self._prefetch > 0 and not self._device_resident:
+            from rocket_tpu.data.prefetch import PrefetchIterator
+
+            runtime = self._runtime
+            transform = None
+            if self._device_placement:
+                self._prefetched_placement = True
+
+                def transform(batch: Batch) -> Batch:
+                    return Batch(
+                        runtime.shard_batch(batch.data), batch.size, batch.index
+                    )
+
+            iterator = PrefetchIterator(
+                iterator, depth=self._prefetch, transform=transform
+            )
+        self._iterator = iterator
 
     def launch(self, attrs: Attributes | None = None) -> None:
         if attrs is None:
@@ -179,7 +204,11 @@ class Dataset(Capsule):
             return
 
         data = batch.data
-        if self._device_placement and not self._device_resident:
+        if (
+            self._device_placement
+            and not self._device_resident
+            and not self._prefetched_placement
+        ):
             data = self._runtime.shard_batch(data)  # dataset.py:111-118
         attrs.batch = data
         attrs.batch_info = Attributes(size=batch.size, index=batch.index)
@@ -189,7 +218,7 @@ class Dataset(Capsule):
 
     def reset(self, attrs: Attributes | None = None) -> None:
         super().reset(attrs)
-        self._iterator = None
+        self._close_iterator()
         self._batch_idx = 0
 
     def destroy(self, attrs: Attributes | None = None) -> None:
@@ -197,8 +226,13 @@ class Dataset(Capsule):
         if self._dataloader is not None and self._runtime is not None:
             self._runtime.dataloaders.remove(self._raw_dataset, self._registry_key)
         self._dataloader = None
-        self._iterator = None
+        self._close_iterator()
         super().destroy(attrs)
+
+    def _close_iterator(self) -> None:
+        it, self._iterator = self._iterator, None
+        if it is not None and hasattr(it, "close"):
+            it.close()  # stop the prefetch worker promptly
 
     # -- Looper inference --------------------------------------------------
 

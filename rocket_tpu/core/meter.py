@@ -75,21 +75,69 @@ class Meter(Dispatcher):
         if attrs.batch_info is not None:
             real_size = attrs.batch_info.size
 
-        gathered = dict(batch) if isinstance(batch, dict) else {}
-        for key in self._keys:
-            gathered[key] = self.gather_for_metrics(batch[key], real_size)
+        gathered = {
+            key: self.gather_for_metrics(batch[key], real_size)
+            for key in self._keys
+        }
 
-        # Children see the gathered batch; the device batch is restored after
-        # (meter.py:36-95's type-preserving clone semantics).
+        # Children see the gathered batch in a type-preserving clone of the
+        # original — Mapping keys or Sequence indices, mutable clones mutated
+        # in place, immutables rebuilt (meter.py:36-90) — and the device
+        # batch is restored after.
         original = attrs.batch
-        attrs.batch = type(batch)(gathered) if isinstance(batch, dict) else gathered
+        attrs.batch = self._clone_with(batch, gathered)
         try:
             Dispatcher.launch(self, attrs)
         finally:
             attrs.batch = original
 
     @staticmethod
+    def _clone_with(batch, gathered: dict):
+        """Clone ``batch`` with ``gathered`` values swapped in at their keys
+        (dict keys or sequence indices), preserving the container type."""
+        import copy
+        from collections.abc import Mapping, Sequence as SeqABC
+
+        if isinstance(batch, Mapping):
+            # Rebuild from items rather than copy.copy: a Mapping wrapper
+            # without __copy__ shares its backing dict, and the key swap
+            # below would mutate the ORIGINAL device batch through it.
+            items = {k: gathered.get(k, v) for k, v in batch.items()}
+            try:
+                return type(batch)(items)
+            except TypeError:
+                originals = {k: batch[k] for k in gathered}
+                clone = copy.copy(batch)
+                for key, value in gathered.items():
+                    clone[key] = value
+                if any(batch[k] is gathered[k] for k in gathered):
+                    # copy.copy shared the backing storage and the swap wrote
+                    # through to the original device batch — undo the writes
+                    # and degrade to a plain-dict clone (container type not
+                    # preserved, but the training batch stays intact).
+                    for k, v in originals.items():
+                        batch[k] = v
+                    return items
+                return clone
+        if isinstance(batch, SeqABC) and not isinstance(batch, (str, bytes)):
+            elems = list(batch)
+            for key, value in gathered.items():
+                elems[key] = value
+            try:
+                return type(batch)(elems)  # tuple-likes take one iterable
+            except TypeError:
+                return type(batch)(*elems)  # namedtuples take positionals
+        # Scalar/opaque batch with a single gathered value: hand it through.
+        return gathered
+
+    @staticmethod
     def _has_key(batch, key) -> bool:
+        from collections.abc import Mapping, Sequence as SeqABC
+
+        if isinstance(batch, Mapping):
+            return key in batch
+        if isinstance(batch, SeqABC) and not isinstance(batch, (str, bytes)):
+            return isinstance(key, int) and -len(batch) <= key < len(batch)
         try:
             return key in batch
         except TypeError:

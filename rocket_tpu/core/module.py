@@ -253,21 +253,47 @@ class Module(Dispatcher):
         if self._param_sharding is None:
             return jax.device_put(state, runtime.replicated)
 
+        from rocket_tpu.utils.pytree import key_path_names as norm
+
         def place(path, leaf):
-            # Normalize jax key-path entries to plain strings ('0', 'w', ...).
-            names = tuple(
-                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
-            )
-            spec = self._param_sharding(names, leaf)
+            spec = self._param_sharding(norm(path), leaf)
             sharding = runtime.replicated if spec is None else runtime.sharding(*spec)
             return jax.device_put(leaf, sharding)
+
+        # Param-shaped optimizer moments (Adam mu/nu, momentum buffers...)
+        # must follow the param layout, or a TP/FSDP run replicates ~2x the
+        # model per device and defeats the sharded layout. An opt_state leaf
+        # at path (..., 'mu', <param path...>) is matched to its param by the
+        # longest path suffix with the same shape; unmatched leaves (step
+        # counters, scalars) replicate.
+        param_layout = {}
+        for ppath, pleaf in jax.tree_util.tree_flatten_with_path(state["params"])[0]:
+            names = norm(ppath)
+            param_layout[names] = (getattr(pleaf, "shape", ()), self._param_sharding(names, pleaf))
+
+        def place_mirrored(path, leaf):
+            names = norm(path)
+            shape = getattr(leaf, "shape", None)
+            for k in range(len(names)):
+                hit = param_layout.get(names[k:])
+                if hit is not None and hit[0] == shape:
+                    spec = hit[1]
+                    sharding = (
+                        runtime.replicated if spec is None else runtime.sharding(*spec)
+                    )
+                    return jax.device_put(leaf, sharding)
+            return jax.device_put(leaf, runtime.replicated)
 
         out = {
             key: jax.device_put(value, runtime.replicated)
             for key, value in state.items()
-            if key not in ("params", "grad_accum")
+            if key not in ("params", "grad_accum", "opt_state")
         }
         out["params"] = jax.tree_util.tree_map_with_path(place, state["params"])
+        if "opt_state" in state:
+            out["opt_state"] = jax.tree_util.tree_map_with_path(
+                place_mirrored, state["opt_state"]
+            )
         if "grad_accum" in state:
             # Accumulator mirrors the param layout.
             out["grad_accum"] = jax.tree_util.tree_map_with_path(
@@ -293,7 +319,14 @@ class Module(Dispatcher):
             return model.apply(variables, batch, mode=mode, rng=rng)
 
         if self._remat:
-            forward = jax.checkpoint(forward, static_argnums=())  # noqa: A001
+            base = forward
+
+            def forward(params, model_state, batch, *, mode, rng):  # noqa: F811
+                # `mode` is a python string — close over it so jax.checkpoint
+                # only sees array (pytree) arguments.
+                fn = lambda p, s, b, r: base(p, s, b, mode=mode, rng=r)  # noqa: E731
+                return jax.checkpoint(fn)(params, model_state, batch, rng)
+
         return forward
 
     def _build_train_step(self, objective, tx) -> None:

@@ -1,0 +1,137 @@
+"""Profiler capsule — step-time observability + jax.profiler traces.
+
+The reference has nothing here (SURVEY §5 row 1: nothing beyond tqdm bars,
+``loop.py:75-79``); this is the planned ``jax.profiler`` trace capsule.
+
+Two jobs:
+
+* **always-on step timing**: host-side wall clock per iteration, published as
+  ``attrs.looper.state.steps_per_sec`` (tqdm postfix) and
+  ``attrs.tracker.scalars`` — and when ``flops_per_step`` (or
+  ``flops_per_sample`` × the batch size) is given, an ``mfu`` scalar against
+  the device's bf16 peak (``utils/perf.py``);
+* **trace capture**: a ``jax.profiler`` trace for steps ``[trace_start,
+  trace_start + trace_steps)`` written to ``trace_dir`` (default
+  ``<runtime.project_dir>/traces``), viewable in TensorBoard/Perfetto.
+  Capturing a few mid-run steps skips compile noise; ``destroy`` closes a
+  still-open trace on early termination.
+
+Host-side timing measures the *dispatch* loop; once the chip is saturated
+dispatch converges to true step time (JAX backpressures on the donated
+buffers), so after a few warmup steps this is the real number.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+__all__ = ["Profiler"]
+
+
+class Profiler(Capsule):
+    def __init__(
+        self,
+        trace_dir: Optional[str] = None,
+        trace_start: Optional[int] = None,
+        trace_steps: int = 3,
+        flops_per_step: Optional[float] = None,
+        flops_per_sample: Optional[float] = None,
+        warmup: int = 2,
+        priority: int = 150,
+        runtime=None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, runtime=runtime)
+        self._trace_dir = trace_dir
+        self._trace_start = trace_start
+        self._trace_steps = int(trace_steps)
+        self._flops_per_step = flops_per_step
+        self._flops_per_sample = flops_per_sample
+        self._warmup = int(warmup)
+        self._iter_idx = 0
+        self._tracing = False
+        self._t_last: Optional[float] = None
+        self._ema: Optional[float] = None  # smoothed step seconds
+        self._peak: Optional[float] = None
+
+    # -- events --------------------------------------------------------------
+
+    def setup(self, attrs: Attributes | None = None) -> None:
+        super().setup(attrs)
+        from rocket_tpu.utils.perf import peak_flops
+
+        self._peak = peak_flops()
+        if self._trace_dir is None and self._runtime is not None:
+            self._trace_dir = os.path.join(self._runtime.project_dir, "traces")
+
+    def set(self, attrs: Attributes | None = None) -> None:
+        super().set(attrs)
+        self._t_last = None  # epoch boundary: don't count inter-epoch time
+
+    def launch(self, attrs: Attributes | None = None) -> None:
+        self._maybe_trace()
+        self._iter_idx += 1
+
+        now = time.perf_counter()
+        if self._t_last is None:
+            self._t_last = now
+            return
+        dt, self._t_last = now - self._t_last, now
+        if self._iter_idx <= self._warmup:
+            return  # compile steps would poison the average
+        self._ema = dt if self._ema is None else 0.9 * self._ema + 0.1 * dt
+
+        steps_per_sec = 1.0 / self._ema if self._ema else 0.0
+        flops = self._flops_per_step
+        if flops is None and self._flops_per_sample is not None and attrs is not None:
+            info = attrs.batch_info
+            if info is not None and info.size is not None:
+                flops = self._flops_per_sample * info.size
+        mfu = None
+        if flops is not None and self._peak:
+            # Per-chip MFU: flops is the GLOBAL step cost, peak is one chip.
+            n_dev = self._runtime.mesh.size if self._runtime is not None else 1
+            mfu = flops * steps_per_sec / (self._peak * n_dev)
+
+        if attrs is None:
+            return
+        if attrs.looper is not None and attrs.looper.state is not None:
+            attrs.looper.state.steps_per_sec = round(steps_per_sec, 2)
+            if mfu is not None:
+                attrs.looper.state.mfu = round(mfu, 4)
+        if attrs.tracker is not None and attrs.tracker.scalars is not None:
+            attrs.tracker.scalars["perf/steps_per_sec"] = steps_per_sec
+            if mfu is not None:
+                attrs.tracker.scalars["perf/mfu"] = mfu
+
+    def destroy(self, attrs: Attributes | None = None) -> None:
+        self._stop_trace()
+        super().destroy(attrs)
+
+    # -- trace window ----------------------------------------------------------
+
+    def _maybe_trace(self) -> None:
+        if self._trace_start is None:
+            return
+        if not self._tracing and self._iter_idx == self._trace_start:
+            import jax
+
+            if self._runtime is None or self._runtime.is_main_process:
+                os.makedirs(self._trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self._trace_dir)
+                self._tracing = True
+                self.log_info(f"profiler: tracing to {self._trace_dir}")
+        elif self._tracing and self._iter_idx >= self._trace_start + self._trace_steps:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self.log_info("profiler: trace complete")

@@ -163,7 +163,10 @@ class DataLoader:
             real = len(global_idx)
             if real < self.batch_size:
                 # Even-batch wrap padding (Accelerate even_batches semantics).
-                pad = order[: self.batch_size - real]
+                # Tile when the dataset itself is shorter than the pad — a
+                # short pad would leave host stripes with unequal shapes and
+                # hang the next collective in multihost runs.
+                pad = np.resize(order, self.batch_size - real)
                 global_idx = np.concatenate([global_idx, pad])
             host_idx = global_idx[lo : lo + stripe]
             if get_batch is not None:

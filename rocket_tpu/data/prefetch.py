@@ -1,0 +1,104 @@
+"""Background batch prefetch — keep the chip fed on streaming paths.
+
+The reference gets multiprocess workers + prefetch for free from
+``torch.utils.data.DataLoader`` (``rocket/core/dataset.py:52-57``). The
+TPU-native analogue: a single daemon thread runs the host loader AND the
+host→device transfer (``Runtime.shard_batch`` → ``jax.device_put``), staying
+``depth`` batches ahead of the training loop through a bounded queue. Device
+transfer is asynchronous under the hood, so by the time ``launch()`` needs a
+batch its bytes are already in HBM — collate and H2D overlap step N-1's
+compute instead of serializing with it.
+
+The device-resident cache (``data/device_cache.py``) covers map-style
+datasets that fit HBM; this covers everything else (streaming datasets,
+multi-host striping, HBM-exceeding corpora).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = ["PrefetchIterator"]
+
+
+class PrefetchIterator:
+    """Iterate ``iterable`` on a daemon thread, ``depth`` items ahead.
+
+    ``transform`` (e.g. the H2D placement) runs on the worker thread.
+    Exceptions in the worker surface at the consumer's ``next()``. ``close()``
+    stops the worker promptly (also called by ``__del__`` and on exhaustion).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        iterable: Iterable[Any],
+        depth: int = 2,
+        transform: Optional[Callable[[Any], Any]] = None,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"PrefetchIterator: depth must be >= 1, got {depth}")
+        self._iterable = iterable
+        self._transform = transform
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._fill, name="rocket-tpu-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _fill(self) -> None:
+        try:
+            for item in self._iterable:
+                if self._transform is not None:
+                    item = self._transform(item)
+                if not self._put(item):
+                    return
+            self._put(self._DONE)
+        except BaseException as e:  # re-raised on the consumer side
+            self._put(e)
+
+    def _put(self, item: Any) -> bool:
+        """Blocking put that aborts when close() was requested."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._DONE:
+            self.close()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self.close()
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and drop queued batches."""
+        self._stop.set()
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -38,8 +38,12 @@ class TransformerConfig:
     mlp_ratio: int = 4
     dropout: float = 0.0
     tied_embeddings: bool = True
-    #: "auto" | "xla" | "flash" — see ``nn.attention.resolve_impl``.
+    #: "auto" | "xla" | "flash" | "ring" — see ``nn.attention.resolve_impl``;
+    #: "ring" shards the sequence over the mesh's ``seq_axis`` (long-context
+    #: sequence parallelism, ``parallel/ring_attention.py``).
     attention_impl: str = "auto"
+    #: Mesh axis for impl="ring".
+    seq_axis: str = "seq"
     #: Activation dtype for the trunk (e.g. "bfloat16"). The LM's input is
     #: int tokens, so ``Module(compute_dtype=...)``'s float-batch cast never
     #: fires — without this the f32 embedding gather silently promotes the
@@ -72,7 +76,7 @@ class Block(Layer):
         self.ln1 = LayerNorm(c.dim)
         self.attn = MultiHeadAttention(
             c.dim, c.num_heads, causal=True, dropout=c.dropout,
-            impl=c.attention_impl,
+            impl=c.attention_impl, seq_axis=c.seq_axis,
         )
         self.ln2 = LayerNorm(c.dim)
         self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
@@ -181,7 +185,10 @@ class TransformerLM(Model):
         if self.drop is not None:
             x, _ = self.drop.apply(
                 {"params": {}, "state": {}}, x, mode=mode,
-                rng=None if rng is None else jax.random.fold_in(rng, 7),
+                # Salt from a domain disjoint with the per-block
+                # fold_in(rng, layer_idx) keys — a small constant would
+                # collide with that block's key and correlate dropout masks.
+                rng=None if rng is None else jax.random.fold_in(rng, 0x0E0BED),
             )
 
         for i, block in enumerate(self.blocks):

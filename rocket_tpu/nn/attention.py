@@ -36,8 +36,8 @@ def resolve_impl(impl: str, t: int, d: int) -> str:
     block size, D <= 128), and the XLA path otherwise — including the
     virtual-CPU test mesh (where pallas would run interpreted, orders of
     magnitude slower) and multi-device runs (where the kernel would need a
-    shard_map seam; for sequence sharding see
-    ``parallel/ring_attention.py``, not yet selectable here).
+    shard_map seam). Sequence-sharded ring attention is selected explicitly
+    with impl="ring" (never by "auto": it needs a 'seq' mesh axis).
     """
     if impl != "auto":
         return impl
@@ -95,13 +95,14 @@ class MultiHeadAttention(Layer):
         dropout: float = 0.0,
         use_bias: bool = True,
         impl: str = "auto",
+        seq_axis: str = "seq",
     ):
         if features % num_heads != 0:
             raise ValueError(
                 f"MultiHeadAttention: features {features} not divisible by "
                 f"num_heads {num_heads}"
             )
-        if impl not in ("auto", "xla", "flash"):
+        if impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"MultiHeadAttention: unknown impl {impl!r}")
         self.features = features
         self.num_heads = num_heads
@@ -109,6 +110,8 @@ class MultiHeadAttention(Layer):
         self.causal = causal
         self.dropout = dropout
         self.impl = impl
+        self.seq_axis = seq_axis
+        self._ring_mesh = None  # pinned at first ring trace
         self.qkv = Dense(features, 3 * features, use_bias=use_bias)
         self.proj = Dense(
             features,
@@ -138,6 +141,33 @@ class MultiHeadAttention(Layer):
             # and out of the kernel (see ops/flash_attention.py).
             out = flash_attention_qkv(
                 jnp.transpose(qkv, (2, 0, 3, 1, 4)), causal=self.causal
+            )
+        elif impl == "ring":
+            # Sequence-parallel ring attention: T is sharded over the mesh's
+            # seq axis; KV blocks rotate over ICI (parallel/ring_attention).
+            from rocket_tpu.parallel.ring_attention import ring_attention_sharded
+            from rocket_tpu.runtime.context import Runtime
+
+            # The mesh is PINNED on first trace: a later Runtime constructed
+            # in the same process must not silently redirect a retrace of
+            # this model onto a different mesh.
+            mesh = self._ring_mesh
+            if mesh is None:
+                runtime = Runtime.current()
+                if runtime is None or self.seq_axis not in runtime.mesh.shape:
+                    raise RuntimeError(
+                        "MultiHeadAttention(impl='ring') needs a live Runtime "
+                        f"whose mesh has a {self.seq_axis!r} axis "
+                        "(e.g. Runtime(mesh_shape={'data': 2, 'seq': 4}))."
+                    )
+                mesh = self._ring_mesh = runtime.mesh
+            q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+            out = ring_attention_sharded(
+                q, k, v,
+                mesh=mesh,
+                seq_axis=self.seq_axis,
+                data_axis="data" if "data" in mesh.shape else None,
+                causal=self.causal,
             )
         else:
             q, k, v = (

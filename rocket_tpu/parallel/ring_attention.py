@@ -82,8 +82,10 @@ def ring_attention(
     # The accumulators become device-varying after one loop step; mark the
     # initial constants as varying over the ring axis so the carry types
     # match (jax >= 0.8 vma checking).
-    if hasattr(jax.lax, "pvary"):
-        axes = (axis_name,) + tuple(vary_axes)
+    axes = (axis_name,) + tuple(vary_axes)
+    if hasattr(jax.lax, "pcast"):
+        m, l, o = (jax.lax.pcast(x, axes, to="varying") for x in (m, l, o))
+    elif hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax
         m, l, o = (jax.lax.pvary(x, axes) for x in (m, l, o))
 
     q_offset = rank * t_loc

@@ -68,21 +68,52 @@ class IdentityRegistry:
         return [prepared for _, prepared in self._entries.values()]
 
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache — first compile of a conv model costs
+    minutes on TPU; every later process (examples, bench, tests, the driver's
+    compile checks) reloads it in milliseconds. Opt out / relocate with
+    ``ROCKET_TPU_CACHE=0`` / ``ROCKET_TPU_CACHE=<dir>``."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    path = os.environ.get(
+        "ROCKET_TPU_CACHE", os.path.expanduser("~/.cache/rocket_tpu/xla")
+    )
+    if path in ("", "0"):
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never fatal
+        logging.getLogger(__name__).warning("compilation cache disabled: %s", e)
+
+
 def _maybe_initialize_distributed() -> None:
     """Join a multi-host JAX runtime when coordinator env vars are present.
 
     Mirrors how ``accelerate launch`` wires ``torch.distributed`` from env
     vars; here the transport is the TPU runtime over ICI/DCN.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coord and os.environ.get("JAX_NUM_PROCESSES"):
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
-            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
-        )
+    if not (coord and os.environ.get("JAX_NUM_PROCESSES")):
+        return
+    # Must not touch the backend before initialize() (jax.process_count()
+    # would initialize it!) — probe the distributed client state directly.
+    from jax._src import distributed as _distributed
+
+    if getattr(_distributed.global_state, "client", None) is not None:
+        return  # already initialized
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
 
 
 class Runtime:
@@ -113,6 +144,15 @@ class Runtime:
     #: the batch over more than one axis (dp+fsdp) extend this tuple.
     DATA_AXES: tuple[str, ...] = ("data",)
 
+    #: Most recently constructed Runtime — the ambient-context analogue of
+    #: accelerate's AcceleratorState singleton, used by layers that need the
+    #: mesh at trace time (ring attention) without threading it explicitly.
+    _current: Optional["Runtime"] = None
+
+    @classmethod
+    def current(cls) -> Optional["Runtime"]:
+        return cls._current
+
     def __init__(
         self,
         mesh: Optional[Mesh] = None,
@@ -123,7 +163,9 @@ class Runtime:
         device_placement: bool = True,
         device_cache_bytes: int = 1 << 30,
         project_dir: str = ".",
+        seq_axis: Optional[str] = None,
     ) -> None:
+        _enable_compilation_cache()
         _maybe_initialize_distributed()
 
         if mesh is None:
@@ -139,6 +181,19 @@ class Runtime:
                 )
             mesh = Mesh(np.asarray(devices).reshape(shape), axis_names)
         self._mesh = mesh
+
+        # Sequence/context parallelism: when the mesh carries a sequence
+        # axis, batches shard their second (token) dimension over it and
+        # attention layers with impl="ring" rotate KV blocks around it.
+        if seq_axis is None and "seq" in mesh.shape:
+            seq_axis = "seq"
+        if seq_axis is not None and seq_axis not in mesh.shape:
+            raise RuntimeError(
+                f"Runtime: seq_axis {seq_axis!r} not in mesh axes "
+                f"{tuple(mesh.shape)}."
+            )
+        self.seq_axis = seq_axis
+        Runtime._current = self
 
         if gradient_accumulation_steps < 1:
             raise RuntimeError("gradient_accumulation_steps must be >= 1")
@@ -206,14 +261,49 @@ class Runtime:
         replicated = self.replicated
 
         n = self.data_axis_size
+        seq_axis = self.seq_axis
+        seq_n = self._mesh.shape[seq_axis] if seq_axis else 1
+        procs = jax.process_count()
+
+        def sharded_put(leaf, target):
+            if procs == 1:
+                return jax.device_put(leaf, target)
+            # True multihost: each process holds only its DataLoader stripe.
+            # device_put would treat the stripe as the (replicated) global
+            # value and fail the cross-process consistency check — the stripe
+            # is process-local data, assembled into one global array here.
+            global_shape = (leaf.shape[0] * procs,) + leaf.shape[1:]
+            return jax.make_array_from_process_local_data(
+                target, np.asarray(leaf), global_shape
+            )
 
         def place(leaf):
             if isinstance(leaf, (np.ndarray, jax.Array)) and np.ndim(leaf) >= 1:
-                if leaf.shape[0] % n != 0:
+                stripe_of = leaf.shape[0] * procs
+                if stripe_of % n != 0:
+                    if procs > 1:
+                        # Host stripes differ — replicating would ship
+                        # different values per process and hang/fail the next
+                        # collective. The loader's wrap padding should have
+                        # prevented this.
+                        raise RuntimeError(
+                            f"shard_batch: global batch {stripe_of} not "
+                            f"divisible over data axis ({n}) in a "
+                            f"{procs}-process run."
+                        )
                     # Batch not divisible over the data axis (tiny datasets,
                     # trailing batches): replicate rather than fail.
                     return jax.device_put(leaf, replicated)
-                return jax.device_put(leaf, sharding)
+                if (
+                    seq_axis
+                    and np.ndim(leaf) >= 2
+                    and leaf.shape[1] % seq_n == 0
+                ):
+                    # Token dim sharded over the sequence axis (ring
+                    # attention / long-context path).
+                    spec = P(self.DATA_AXES, seq_axis)
+                    return sharded_put(leaf, NamedSharding(self._mesh, spec))
+                return sharded_put(leaf, sharding)
             if isinstance(leaf, (np.ndarray, jax.Array, int, float, complex, bool)):
                 return jax.device_put(jnp.asarray(leaf), replicated)
             return leaf  # strings etc. pass through (utils.py:19-27 semantics)

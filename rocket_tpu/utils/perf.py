@@ -1,0 +1,30 @@
+"""Device peak-FLOPs table and MFU helpers (used by bench.py and the
+Profiler capsule)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["PEAK_FLOPS", "peak_flops"]
+
+#: bf16 peak by device kind — MFU denominators.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """bf16 peak for the device kind, or None when unknown (callers should
+    omit MFU rather than compute it against the wrong peak)."""
+    kind = (device or jax.devices()[0]).device_kind
+    # Longest prefix wins ("TPU v5 lite" before "TPU v5").
+    best = None
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
+            best = (len(prefix), peak)
+    return None if best is None else best[1]

@@ -1,0 +1,22 @@
+"""Pytree key-path helpers shared by sharding rules and checkpoint I/O."""
+
+from __future__ import annotations
+
+__all__ = ["key_path_names", "key_path_str"]
+
+
+def key_path_names(path) -> tuple[str, ...]:
+    """Normalize a jax key path to plain name strings.
+
+    DictKey carries ``.key``, SequenceKey ``.idx``, GetAttrKey (namedtuple
+    fields, e.g. optax state) ``.name`` — one chain so every caller agrees on
+    the spelling of a leaf path.
+    """
+    return tuple(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+        for p in path
+    )
+
+
+def key_path_str(path) -> str:
+    return "/".join(key_path_names(path))

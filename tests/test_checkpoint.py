@@ -65,7 +65,12 @@ def test_checkpoint_layout_written(tmp_path):
     # 256/32 = 8 iterations, save_every=4 -> steps 4 and 8
     assert sorted(os.listdir(ckpt)) == ["4", "8"]
     step_dir = os.path.join(ckpt, "8")
-    assert set(os.listdir(step_dir)) == {"model_0.pkl", "capsules.pkl", "rng.pkl"}
+    assert set(os.listdir(step_dir)) == {"model_0", "capsules.pkl", "rng.json"}
+    # Sharded pickle-free layout: one npz per host + a JSON chunk index.
+    assert set(os.listdir(os.path.join(step_dir, "model_0"))) == {
+        "shard_p0.npz",
+        "index.json",
+    }
 
 
 def test_resume_restores_params_and_counters(tmp_path):
@@ -80,7 +85,7 @@ def test_resume_restores_params_and_counters(tmp_path):
     # -> re-read from the written checkpoint instead
     from rocket_tpu.runtime.checkpoint_io import load_pytree
 
-    saved = load_pytree(os.path.join(ckpt, "8", "model_0.pkl"))
+    saved = load_pytree(os.path.join(ckpt, "8", "model_0"))
 
     runtime2 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
     model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
@@ -91,7 +96,7 @@ def test_resume_restores_params_and_counters(tmp_path):
     tree2.setup(attrs)
     restored = module2.state
     np.testing.assert_allclose(
-        np.asarray(saved["params"]["1"]["w"]),
+        saved["params/1/w"],
         np.asarray(restored["params"]["1"]["w"]),
     )
     assert int(np.asarray(restored["step"])) == 8
@@ -137,6 +142,57 @@ def test_resume_capsules_false_skips_capsule_state(tmp_path):
     assert int(np.asarray(module2.state["step"])) == 8
     assert tree2.state_dict()["epoch_idx"] == 0
     tree2.destroy(attrs)
+
+
+def test_sharded_save_is_gather_free_and_reshards(tmp_path, monkeypatch):
+    """TP-sharded state saves with NO process_allgather and restores
+    bit-exact under a *different* layout (VERDICT r1 item 4)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from rocket_tpu.runtime.checkpoint_io import load_pytree, save_pytree
+
+    def boom(*a, **k):
+        raise AssertionError("save path must not gather across hosts")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+
+    runtime = Runtime(mesh_shape={"data": 2, "model": 4}, project_dir=str(tmp_path))
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 32)).astype(np.float32)
+    b = rng.normal(size=(32,)).astype(np.float32)
+    tree = {
+        "params": {
+            "w": jax.device_put(w, runtime.sharding(None, "model")),
+            "b": jax.device_put(b, runtime.sharding("model")),
+        },
+        "step": jnp.asarray(7),
+        "note": "plain-json-leaf",
+    }
+    path = str(tmp_path / "ckpt")
+    save_pytree(path, tree)
+
+    # Restore under a transposed layout (row-parallel w, replicated b).
+    template = {
+        "params": {
+            "w": jax.device_put(np.zeros_like(w), runtime.sharding("model", None)),
+            "b": jax.device_put(np.zeros_like(b), runtime.replicated),
+        },
+        "step": jnp.asarray(0),
+        "note": "",
+    }
+    out = load_pytree(path, template)
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]), w)
+    np.testing.assert_array_equal(np.asarray(out["params"]["b"]), b)
+    assert out["params"]["w"].sharding == template["params"]["w"].sharding
+    assert int(out["step"]) == 7
+    assert out["note"] == "plain-json-leaf"
+
+    # Flat introspection load (no template) assembles full arrays.
+    flat = load_pytree(path)
+    np.testing.assert_array_equal(flat["params/w"], w)
+    assert flat["note"] == "plain-json-leaf"
 
 
 def test_keep_last_prunes_old_checkpoints(tmp_path):

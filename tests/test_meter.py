@@ -1,0 +1,78 @@
+"""Meter unit tests — gathered-batch clone semantics for Mapping AND
+Sequence batches (reference meter.py:36-90), padding trim, key errors."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.meter import Meter, Metric
+
+
+class Recorder(Metric):
+    def __init__(self):
+        super().__init__(priority=1000)
+        self.seen = None
+
+    def launch(self, attrs=None):
+        self.seen = attrs.batch
+
+    def reset(self, attrs=None):
+        self.seen = None
+
+
+def run_meter(keys, batch, size=None):
+    rec = Recorder()
+    meter = Meter(keys, [rec])
+    attrs = Attributes()
+    attrs.batch = batch
+    original = attrs.batch  # Attributes converts assigned mappings
+    if size is not None:
+        attrs.batch_info = Attributes(size=size)
+    meter.launch(attrs)
+    # The device batch is restored after the children ran.
+    assert attrs.batch is original
+    return rec.seen
+
+
+def test_dict_batch_gather_and_trim():
+    batch = {"logits": np.arange(8.0), "label": np.arange(8), "skip": "s"}
+    seen = run_meter(["logits", "label"], batch, size=5)
+    assert isinstance(seen, dict)
+    np.testing.assert_array_equal(seen["logits"], np.arange(5.0))
+    np.testing.assert_array_equal(seen["label"], np.arange(5))
+    assert seen["skip"] == "s"
+
+
+def test_list_batch_indices():
+    batch = [np.arange(6.0), np.arange(6), "tag"]
+    seen = run_meter([0, 1], batch, size=4)
+    assert isinstance(seen, list)
+    np.testing.assert_array_equal(seen[0], np.arange(4.0))
+    np.testing.assert_array_equal(seen[1], np.arange(4))
+    assert seen[2] == "tag"
+
+
+def test_tuple_batch_is_rebuilt():
+    batch = (np.arange(6.0), "tag")
+    seen = run_meter([0], batch, size=3)
+    assert isinstance(seen, tuple)
+    np.testing.assert_array_equal(seen[0], np.arange(3.0))
+    assert seen[1] == "tag"
+
+
+def test_namedtuple_batch_preserves_type():
+    Pair = collections.namedtuple("Pair", ["logits", "label"])
+    batch = Pair(np.arange(6.0), np.arange(6))
+    seen = run_meter([0, 1], batch, size=2)
+    assert isinstance(seen, Pair)
+    np.testing.assert_array_equal(seen.logits, np.arange(2.0))
+
+
+def test_missing_key_raises():
+    with pytest.raises(KeyError):
+        run_meter(["nope"], {"logits": np.arange(4.0)})
+    with pytest.raises(KeyError):
+        run_meter([5], [np.arange(4.0)])

@@ -1,0 +1,124 @@
+"""Two-process jax.distributed smoke test (VERDICT r1 item 9).
+
+Spawns 2 real OS processes on CPU (2 virtual devices each → a 4-device
+global mesh), joined through a localhost coordinator via the same env vars
+``Runtime._maybe_initialize_distributed`` reads in production. Exercises the
+branches that otherwise never run as true multihost: distributed init, the
+all-rank barrier, per-host striped loading, cross-process training
+collectives, and the sharded (gather-free) checkpoint save from BOTH hosts.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.runtime.context import Runtime
+
+runtime = Runtime(mesh_shape={"data": 4}, seed=0, project_dir=os.environ["OUT"])
+assert jax.process_count() == 2, jax.process_count()
+rank = runtime.process_index
+
+# All-rank barrier (the reference's rank-0-only deadlock fixed).
+runtime.wait_for_everyone()
+
+rng = np.random.default_rng(0)
+data = [
+    {"image": rng.normal(size=8).astype(np.float32), "label": np.int32(i % 4)}
+    for i in range(128)
+]
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+model = MLP(in_features=8, num_classes=4, hidden=(16,))
+ckpt_dir = os.path.join(os.environ["OUT"], "ckpts")
+tree = rt.Launcher(
+    [
+        rt.Looper(
+            [
+                # device_cache off multihost -> striped streaming loader.
+                rt.Dataset(data, batch_size=32),
+                rt.Module(
+                    model,
+                    capsules=[
+                        rt.Loss(cross_entropy),
+                        rt.Optimizer(optim.adam(), learning_rate=1e-2),
+                    ],
+                ),
+                rt.Checkpointer(output_dir=ckpt_dir, save_every=4),
+            ],
+            tag="train",
+            progress=False,
+        )
+    ],
+    num_epochs=1,
+    runtime=runtime,
+)
+tree.launch()
+
+# Both hosts contributed shard files; the index lists them.
+step_dir = os.path.join(ckpt_dir, "4", "model_0")
+assert os.path.exists(os.path.join(step_dir, f"shard_p{rank}.npz")), os.listdir(step_dir)
+if rank == 0:
+    assert os.path.exists(os.path.join(step_dir, "index.json"))
+print(f"RANK{rank} OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_train_and_checkpoint(tmp_path):
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(rank),
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            JAX_PLATFORMS="cpu",
+            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            OUT=str(tmp_path),
+        )
+        # A worker must not inherit a single-process test runtime.
+        env.pop("JAX_PLATFORM_NAME", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        outs.append(out)
+        assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"RANK{rank} OK" in out, out

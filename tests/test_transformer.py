@@ -105,6 +105,12 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
         def launch(self, attrs=None):
             w = module.state["params"]["blocks"]["0"]["attn"]["qkv"]["w"]
             seen["spec"] = str(w.sharding.spec)
+            # Adam moments mirror the param layout (ADVICE r1): a replicated
+            # mu under TP/FSDP would cost ~2x model bytes per device.
+            mu = module.state["opt_state"][0].mu
+            seen["mu_spec"] = str(
+                mu["blocks"]["0"]["attn"]["qkv"]["w"].sharding.spec
+            )
 
     rt.Launcher(
         [rt.Looper([rt.Dataset(data, batch_size=16), module, ShardSpy()],
@@ -115,6 +121,9 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
     # Params kept their sharded layout through training.
     if rules == "tp":
         assert "model" in seen["spec"], seen
+        assert "model" in seen["mu_spec"], seen
+    else:
+        assert "data" in seen["mu_spec"], seen
 
 
 def test_token_dataset_windows():
@@ -125,3 +134,52 @@ def test_token_dataset_windows():
     batch = ds.get_batch(np.asarray([0, 2]))
     assert batch["tokens"].shape == (2, 10)
     np.testing.assert_array_equal(batch["tokens"][1], np.arange(20, 30))
+
+
+def _train_losses(tmp_path, mesh_shape, attention_impl, tag):
+    """Short training run, returns the per-step losses (VERDICT r1 item 5:
+    ring-attention sequence parallelism must match the unsharded run)."""
+    n_dev = int(np.prod(list(mesh_shape.values())))
+    runtime = Runtime(
+        mesh_shape=mesh_shape,
+        devices=jax.devices()[:n_dev],
+        seed=0,
+        project_dir=str(tmp_path),
+    )
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=32, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0, attention_impl=attention_impl,
+    )
+    model = TransformerLM(config)
+    rng = np.random.default_rng(0)
+    data = TokenDataset(rng.integers(0, 64, size=33 * 64).astype(np.int32), seq_len=32)
+    losses = []
+
+    class Spy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+
+        def launch(self, attrs=None):
+            if attrs.looper.state.loss is not None:
+                losses.append(float(np.asarray(attrs.looper.state.loss)))
+
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(next_token_loss()), rt.Optimizer(optim.adam(), learning_rate=1e-3)],
+    )
+    rt.Launcher(
+        [rt.Looper([rt.Dataset(data, batch_size=16, drop_last=True), module, Spy()],
+                   tag=tag, progress=False)],
+        num_epochs=1,
+        runtime=runtime,
+    ).launch()
+    return losses
+
+
+def test_ring_attention_matches_unsharded_training(tmp_path):
+    """Same seed, same data: seq sharded over 4 devices (ring) vs one-axis
+    data-parallel (xla attention) — losses must agree to fp tolerance."""
+    ring = _train_losses(tmp_path / "ring", {"data": 2, "seq": 4}, "ring", "train")
+    base = _train_losses(tmp_path / "base", {"data": 2}, "xla", "train")
+    assert len(ring) == len(base) and len(ring) >= 4
+    np.testing.assert_allclose(ring, base, rtol=2e-4, atol=2e-5)
