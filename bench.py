@@ -102,6 +102,10 @@ class Timer(rt.Capsule):
                 int(l.size) for l in jax.tree.leaves(self._module.state["params"])
             )
         if self.count == self._warmup:
+            # device_get, not block_until_ready: through the tunneled
+            # runtime, block_until_ready has been observed to return before
+            # execution actually retires (a GPT-2 window once timed at an
+            # impossible 7x MFU); fetching the counter value is unambiguous.
             int(np.asarray(self._last_step))  # true device sync
             self.t0 = time.perf_counter()
 

@@ -38,6 +38,8 @@ import json
 import os
 from typing import Optional
 
+import numpy as np
+
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import PRIORITY_CHECKPOINT, Capsule
 from rocket_tpu.runtime import checkpoint_io
@@ -166,6 +168,13 @@ class Checkpointer(Capsule):
             if os.path.isdir(model_path):
                 prepared.state = checkpoint_io.load_pytree(
                     model_path, template=prepared.state
+                )
+                # Host-side step mirror (PreparedModule.host_step): read from
+                # the index, NOT the device — a device fetch here degrades
+                # H2D pipelining on tunneled transports. load_pytree above
+                # already validated the "step" leaf exists.
+                prepared.host_step = int(
+                    np.asarray(checkpoint_io.load_leaf(model_path, "step"))
                 )
             elif os.path.exists(model_path + ".pkl"):
                 raise RuntimeError(

@@ -68,7 +68,6 @@ class Dataset(Capsule):
         # dominant cost on TPU for small datasets — see data/device_cache.py).
         self._device_cache = device_cache
         self._device_resident = False
-        self._prefetched_placement = False
         self._dataloader: Optional[DataLoader] = None
         self._iterator = None
         self._total: Optional[int] = None
@@ -172,23 +171,15 @@ class Dataset(Capsule):
         self._total = self._dataloader.total
         self._close_iterator()
         iterator = iter(self._dataloader)
-        self._prefetched_placement = False
         if self._prefetch > 0 and not self._device_resident:
             from rocket_tpu.data.prefetch import PrefetchIterator
 
-            runtime = self._runtime
-            transform = None
-            if self._device_placement:
-                self._prefetched_placement = True
-
-                def transform(batch: Batch) -> Batch:
-                    return Batch(
-                        runtime.shard_batch(batch.data), batch.size, batch.index
-                    )
-
-            iterator = PrefetchIterator(
-                iterator, depth=self._prefetch, transform=transform
-            )
+            # Worker stays HOST-side (read + collate); the H2D transfer
+            # happens on the consumer thread under the dispatch throttle
+            # below — device_puts issued from a worker interleave with the
+            # queued steps, which stalls the transfer path (measured ~100x
+            # on the tunneled TPU).
+            iterator = PrefetchIterator(iterator, depth=self._prefetch)
         self._iterator = iterator
 
     def launch(self, attrs: Attributes | None = None) -> None:
@@ -204,11 +195,7 @@ class Dataset(Capsule):
             return
 
         data = batch.data
-        if (
-            self._device_placement
-            and not self._device_resident
-            and not self._prefetched_placement
-        ):
+        if self._device_placement and not self._device_resident:
             data = self._runtime.shard_batch(data)  # dataset.py:111-118
         attrs.batch = data
         attrs.batch_info = Attributes(size=batch.size, index=batch.index)

@@ -110,6 +110,12 @@ class PreparedModule:
         # Which layout the state carries: None (not yet placed), "default"
         # (replicated), or "rule" (an explicit param_sharding was applied).
         self.placed_by: Optional[str] = None
+        # Host mirror of state["step"], maintained WITHOUT device reads: 0 at
+        # init, overwritten by the Checkpointer from the (host-side)
+        # checkpoint index on resume. A device_get here would poison the
+        # tunnel transport's H2D pipelining (measured ~100x on streaming
+        # paths after a single scalar fetch).
+        self.host_step: int = 0
 
 
 class Module(Dispatcher):
@@ -437,14 +443,15 @@ class Module(Dispatcher):
                     "give this Module its post-forward pipeline or run it in "
                     "an eval Looper."
                 )
-            # Mirror the device-side step counter once (a single host sync at
-            # the first step / after a resume); afterwards host and device
-            # derive the sync boundary from the same number.
+            # Mirror of the device-side step counter, read from the prepared
+            # record (maintained host-side; never a device fetch — see
+            # PreparedModule.host_step).
             if self._host_step is None:
-                self._host_step = int(np.asarray(state["step"]))
+                self._host_step = int(self._prepared.host_step)
             new_state, metrics = self._train_step(state, dynamic)
             self._prepared.state = new_state
             self._host_step += 1
+            self._prepared.host_step = self._host_step
             accum = self._runtime.gradient_accumulation_steps
             attrs.sync_gradients = (self._host_step % accum) == 0
             outputs = metrics.pop("outputs", None)

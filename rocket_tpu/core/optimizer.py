@@ -67,7 +67,9 @@ class Optimizer(Capsule):
             if attrs.looper is not None:
                 attrs.looper.state.lr = attrs.step_metrics.lr
 
-    # -- checkpoint state (optimizer.py:81-85 — here actually wired) -------
+    # -- checkpoint state (optimizer.py:81-85). Wired, but OFF by default:
+    # saved only when constructed with statefull=True — the optimizer's
+    # device state (moments) is checkpointed with the model regardless. -----
 
     def state_dict(self) -> dict:
         return {"iter_idx": self._iter_idx}

@@ -2,12 +2,15 @@
 
 The reference gets multiprocess workers + prefetch for free from
 ``torch.utils.data.DataLoader`` (``rocket/core/dataset.py:52-57``). The
-TPU-native analogue: a single daemon thread runs the host loader AND the
-host→device transfer (``Runtime.shard_batch`` → ``jax.device_put``), staying
-``depth`` batches ahead of the training loop through a bounded queue. Device
-transfer is asynchronous under the hood, so by the time ``launch()`` needs a
-batch its bytes are already in HBM — collate and H2D overlap step N-1's
-compute instead of serializing with it.
+TPU-native analogue: a single daemon thread runs the HOST side of the loader
+(read + collate), staying ``depth`` batches ahead of the training loop
+through a bounded queue, so host data work overlaps step N-1's compute.
+
+Keep ``transform`` host-only. Do NOT issue device work (``device_put`` /
+``shard_batch``) from the worker: transfers interleaved with the main
+thread's queued step dispatches stall the tunneled transfer path (measured
+~100x on this hardware) — the consumer thread does the H2D after dequeue
+(``core/dataset.py``).
 
 The device-resident cache (``data/device_cache.py``) covers map-style
 datasets that fit HBM; this covers everything else (streaming datasets,
@@ -26,9 +29,10 @@ __all__ = ["PrefetchIterator"]
 class PrefetchIterator:
     """Iterate ``iterable`` on a daemon thread, ``depth`` items ahead.
 
-    ``transform`` (e.g. the H2D placement) runs on the worker thread.
-    Exceptions in the worker surface at the consumer's ``next()``. ``close()``
-    stops the worker promptly (also called by ``__del__`` and on exhaustion).
+    ``transform`` runs on the worker thread — host-side work only (see
+    module docstring). Exceptions in the worker surface at the consumer's
+    ``next()``. ``close()`` stops the worker promptly (also called by
+    ``__del__`` and on exhaustion).
     """
 
     _DONE = object()

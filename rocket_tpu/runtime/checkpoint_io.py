@@ -262,6 +262,19 @@ def _assemble(meta: dict, reader: _ChunkReader, want: tuple) -> np.ndarray:
     return out
 
 
+def load_leaf(path: str, name: str) -> Any:
+    """Read ONE leaf from a checkpoint directory to host (numpy / scalar)
+    without touching any device — e.g. the step counter a resume needs
+    host-side."""
+    with open(os.path.join(path, _INDEX), "r", encoding="utf-8") as f:
+        index = json.load(f)
+    meta = index[name]
+    if meta["kind"] == "json":
+        return meta["value"]
+    shape = tuple(meta["shape"])
+    return _assemble(meta, _ChunkReader(path), tuple((0, d) for d in shape))
+
+
 def load_pytree(path: str, template: Any | None = None) -> Any:
     """Restore a checkpoint directory.
 

@@ -72,19 +72,23 @@ _cache_enabled = False
 
 
 def _enable_compilation_cache() -> None:
-    """Persistent XLA compilation cache — first compile of a conv model costs
-    minutes on TPU; every later process (examples, bench, tests, the driver's
-    compile checks) reloads it in milliseconds. Opt out / relocate with
-    ``ROCKET_TPU_CACHE=0`` / ``ROCKET_TPU_CACHE=<dir>``."""
+    """Persistent XLA compilation cache, OPT-IN via ``ROCKET_TPU_CACHE=<dir>``
+    (or ``=1`` for the default location).
+
+    First compile of a conv model costs minutes on TPU and the cache reloads
+    it in milliseconds — but measured on the tunneled v5e, *deserialized*
+    executables run ~40% slower steady-state than freshly compiled ones, so
+    it must never be on for benchmarking/production. Compile-dominated runs
+    (examples/mnist.py, cifar_resnet.py) opt in themselves."""
     global _cache_enabled
     if _cache_enabled:
         return
     _cache_enabled = True
-    path = os.environ.get(
-        "ROCKET_TPU_CACHE", os.path.expanduser("~/.cache/rocket_tpu/xla")
-    )
+    path = os.environ.get("ROCKET_TPU_CACHE", "0")
     if path in ("", "0"):
         return
+    if path == "1":
+        path = os.path.expanduser("~/.cache/rocket_tpu/xla")
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
@@ -223,6 +227,8 @@ class Runtime:
         # Tracker backends keyed by name (reference `log_with`/`get_tracker`).
         self.trackers: dict[str, Any] = {}
 
+        self._warned_replicated_batch = False
+
     # -- mesh & sharding ---------------------------------------------------
 
     @property
@@ -265,19 +271,8 @@ class Runtime:
         seq_n = self._mesh.shape[seq_axis] if seq_axis else 1
         procs = jax.process_count()
 
-        def sharded_put(leaf, target):
-            if procs == 1:
-                return jax.device_put(leaf, target)
-            # True multihost: each process holds only its DataLoader stripe.
-            # device_put would treat the stripe as the (replicated) global
-            # value and fail the cross-process consistency check — the stripe
-            # is process-local data, assembled into one global array here.
-            global_shape = (leaf.shape[0] * procs,) + leaf.shape[1:]
-            return jax.make_array_from_process_local_data(
-                target, np.asarray(leaf), global_shape
-            )
-
-        def place(leaf):
+        def leaf_sharding(leaf):
+            """Target sharding for one leaf, or None for passthrough."""
             if isinstance(leaf, (np.ndarray, jax.Array)) and np.ndim(leaf) >= 1:
                 stripe_of = leaf.shape[0] * procs
                 if stripe_of % n != 0:
@@ -292,23 +287,60 @@ class Runtime:
                             f"{procs}-process run."
                         )
                     # Batch not divisible over the data axis (tiny datasets,
-                    # trailing batches): replicate rather than fail.
-                    return jax.device_put(leaf, replicated)
-                if (
-                    seq_axis
-                    and np.ndim(leaf) >= 2
-                    and leaf.shape[1] % seq_n == 0
-                ):
+                    # trailing batches): replicate rather than fail — but say
+                    # so once, because the step then runs at 1/n throughput.
+                    if not self._warned_replicated_batch:
+                        self._warned_replicated_batch = True
+                        self.get_logger("runtime").warning(
+                            "shard_batch: batch dim %d not divisible over the "
+                            "%d-way data axis; replicating (slow path). Pad "
+                            "or drop_last to keep batches even.",
+                            leaf.shape[0], n,
+                        )
+                    return replicated
+                if seq_axis and np.ndim(leaf) >= 2 and leaf.shape[1] % seq_n == 0:
                     # Token dim sharded over the sequence axis (ring
                     # attention / long-context path).
-                    spec = P(self.DATA_AXES, seq_axis)
-                    return sharded_put(leaf, NamedSharding(self._mesh, spec))
-                return sharded_put(leaf, sharding)
+                    return NamedSharding(self._mesh, P(self.DATA_AXES, seq_axis))
+                return sharding
             if isinstance(leaf, (np.ndarray, jax.Array, int, float, complex, bool)):
-                return jax.device_put(jnp.asarray(leaf), replicated)
-            return leaf  # strings etc. pass through (utils.py:19-27 semantics)
+                return replicated
+            return None  # strings etc. pass through (utils.py:19-27 semantics)
 
-        return jax.tree.map(place, batch)
+        flat, treedef = jax.tree.flatten(batch)
+        out = list(flat)
+        idx, leaves, targets = [], [], []
+        for i, leaf in enumerate(flat):
+            target = leaf_sharding(leaf)
+            if target is None:
+                continue
+            idx.append(i)
+            leaves.append(leaf if np.ndim(leaf) else jnp.asarray(leaf))
+            targets.append(target)
+
+        if procs == 1:
+            if leaves:
+                # ONE device_put for the whole batch: on the tunneled TPU a
+                # second back-to-back put stalls ~150 ms behind the first
+                # (measured), so per-leaf puts made streaming ~50x slower
+                # than a single batched transfer.
+                placed = jax.device_put(leaves, targets)
+                for i, value in zip(idx, placed):
+                    out[i] = value
+        else:
+            # True multihost: each process holds only its DataLoader stripe.
+            # device_put would treat the stripe as the (replicated) global
+            # value and fail the cross-process consistency check — the stripe
+            # is process-local data, assembled into one global array here.
+            for i, leaf, target in zip(idx, leaves, targets):
+                if target is replicated:
+                    out[i] = jax.device_put(leaf, target)
+                    continue
+                global_shape = (leaf.shape[0] * procs,) + tuple(leaf.shape[1:])
+                out[i] = jax.make_array_from_process_local_data(
+                    target, np.asarray(leaf), global_shape
+                )
+        return jax.tree.unflatten(treedef, out)
 
     # -- process topology --------------------------------------------------
 

@@ -48,9 +48,31 @@ class Accuracy(Metric):
         self._correct += int((preds == labels).sum())
         self._total += int(labels.shape[0])
 
+    # Compiled on-device path (Meter skips the full logits D2H — the
+    # dominant eval cost on TPU; only two lazy scalars leave the step, and
+    # they are materialized once per epoch in reset()).
+    def device_reduce(self, batch, real_size):
+        import jax.numpy as jnp
+
+        logits = batch[self._logits_key]
+        labels = batch[self._labels_key]
+        preds = jnp.argmax(logits, axis=-1)
+        valid = jnp.arange(labels.shape[0]) < real_size
+        return {
+            "correct": jnp.sum((preds == labels) & valid),
+            "total": real_size,
+        }
+
+    def consume(self, reduced) -> None:
+        # Lazy device adds — no per-batch D2H; reset() materializes.
+        self._correct = self._correct + reduced["correct"]
+        self._total = self._total + reduced["total"]
+
     def reset(self, attrs: Attributes | None = None) -> None:
-        if self._total:
-            self.value = self._correct / self._total
+        # THE once-per-epoch materialization point for the lazy accumulators.
+        total = int(np.asarray(self._total))
+        if total:
+            self.value = float(np.asarray(self._correct)) / total
             if attrs is not None:
                 if attrs.tracker is not None:
                     attrs.tracker.scalars[self._tag] = self.value
