@@ -167,11 +167,17 @@ def bench_resnet18(warmup=5, steps=30, batch=256):
         runtime, timer,
     )
     per_chip = batch * steps / elapsed / n_dev
-    return {
+    out = {
         "metric": "cifar_resnet18_samples_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "samples/sec/chip",
     }
+    peak = peak_flops()
+    if peak is not None:
+        # CIFAR-stem ResNet-18 @32x32: ~0.557 G MACs = ~1.11 GFLOP forward
+        # per sample; training ~3x forward.
+        out["mfu"] = round(per_chip * 3 * 2 * 0.557e9 / peak, 4)
+    return out
 
 
 def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):

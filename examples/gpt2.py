@@ -43,6 +43,10 @@ def main():
         "--trace-at", type=int, default=None,
         help="capture a jax.profiler trace for 3 steps starting here",
     )
+    parser.add_argument(
+        "--scan-layers", action="store_true",
+        help="lax.scan over stacked blocks (compiles one block, not 12)",
+    )
     args = parser.parse_args()
 
     n_dev = len(jax.devices())
@@ -60,6 +64,10 @@ def main():
         )
     else:
         config = TransformerConfig.gpt2_124m(max_seq_len=args.seq_len)
+    if args.scan_layers:
+        import dataclasses
+
+        config = dataclasses.replace(config, scan_layers=True)
     model = TransformerLM(config)
     # Analytic param count (embeddings + 12d^2 per block) — MFU denominator.
     n_params = (
@@ -95,6 +103,8 @@ def main():
                         ],
                         param_sharding=gpt2_tp_rules() if args.model_axis > 1 else None,
                         compute_dtype=jnp.bfloat16,
+                        # With --scan-layers Module auto-skips this outer
+                        # remat (the scanned blocks checkpoint themselves).
                         remat=not args.small,
                     ),
                     rt.Checkpointer(output_dir="checkpoints/gpt2", save_every=1000,

@@ -324,7 +324,19 @@ class Module(Dispatcher):
             variables = {"params": params, "state": model_state}
             return model.apply(variables, batch, mode=mode, rng=rng)
 
-        if self._remat:
+        remat = self._remat
+        cfg = getattr(self._model, "config", None)
+        if (
+            remat
+            and getattr(cfg, "scan_layers", False)
+            and getattr(cfg, "scan_remat", False)
+        ):
+            # The scanned blocks already checkpoint themselves (the
+            # scan+remat recipe); an outer checkpoint would recompute the
+            # whole scan AND each block again inside it.
+            self.log_info("remat=True ignored: scan_layers already remats per block")
+            remat = False
+        if remat:
             base = forward
 
             def forward(params, model_state, batch, *, mode, rng):  # noqa: F811

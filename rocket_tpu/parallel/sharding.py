@@ -21,12 +21,24 @@ RuleFn = Callable[[Tuple[str, ...], object], Spec]
 
 def make_rules(rules: Sequence[Tuple[str, Spec]]) -> RuleFn:
     """Build a param_sharding fn from ``[(glob, spec), ...]``; first match
-    wins; no match -> replicated (None)."""
+    wins; no match -> replicated (None).
+
+    Specs are written for a layer's natural rank; a leaf with EXTRA leading
+    dims (the stacked ``blocks_stacked`` layout of ``scan_layers``) gets the
+    spec left-padded with None so the same rule set serves both layouts.
+    """
 
     def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
         joined = "/".join(path)
         for pattern, spec in rules:
             if fnmatch.fnmatch(joined, pattern):
+                shape = getattr(leaf, "shape", None)
+                if (
+                    spec is not None
+                    and shape is not None
+                    and len(shape) > len(spec)
+                ):
+                    spec = (None,) * (len(shape) - len(spec)) + tuple(spec)
                 return spec
         return None
 
@@ -57,14 +69,24 @@ def gpt2_tp_rules(axis: str = "model") -> RuleFn:
     )
 
 
-def fsdp_rules(axis: str = "data", min_size: int = 2**16) -> RuleFn:
+def fsdp_rules(
+    axis: str = "data",
+    min_size: int = 2**16,
+    stacked_prefixes: Tuple[str, ...] = ("blocks_stacked",),
+) -> RuleFn:
     """ZeRO-3-style fully-sharded layout: every large param sharded on its
-    first axis (XLA all-gathers params per-layer and reduce-scatters grads)."""
+    first NATURAL axis (XLA all-gathers params per-layer and reduce-scatters
+    grads). Leaves under a ``stacked_prefixes`` subtree (the scan-over-layers
+    layout) carry an extra leading layer dim — the shard axis shifts right
+    one so the weight dim, not the layer dim, is sharded."""
 
     def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
         shape = getattr(leaf, "shape", ())
         if not shape or leaf.size < min_size:
             return None
-        return (axis,) + (None,) * (len(shape) - 1)
+        spec = (axis,) + (None,) * (len(shape) - 1)
+        if path and path[0] in stacked_prefixes and len(shape) > 1:
+            spec = (None, axis) + (None,) * (len(shape) - 2)
+        return spec
 
     return rule_fn
