@@ -9,6 +9,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax
 import numpy as np
 
 import rocket_tpu as rt
@@ -36,25 +37,38 @@ def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
     steps_per_epoch = len(train_data) // batch_size
     total_steps = max(1, steps_per_epoch * num_epochs)
 
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(next_token_loss()),
+            rt.Optimizer(optim.adamw(weight_decay=0.1)),
+            rt.Scheduler(
+                optim.warmup_cosine_lr(
+                    3e-4, warmup_steps=max(1, total_steps // 20),
+                    decay_steps=total_steps,
+                )
+            ),
+        ],
+    )
+
+    # Keep a handle on the trained params past destroy (for sampling below).
+    trained = {}
+
+    class Keep(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=10)
+
+        def launch(self, attrs=None):
+            trained["params"] = module.state["params"]
+
     launcher = rt.Launcher(
         [
             rt.Looper(
                 [
                     rt.Dataset(train_data, batch_size=batch_size, shuffle=True,
                                drop_last=True),
-                    rt.Module(
-                        model,
-                        capsules=[
-                            rt.Loss(next_token_loss()),
-                            rt.Optimizer(optim.adamw(weight_decay=0.1)),
-                            rt.Scheduler(
-                                optim.warmup_cosine_lr(
-                                    3e-4, warmup_steps=max(1, total_steps // 20),
-                                    decay_steps=total_steps,
-                                )
-                            ),
-                        ],
-                    ),
+                    module,
+                    Keep(),
                     rt.Checkpointer(output_dir="checkpoints/char_lm", save_every=500),
                     rt.Tracker(backend="jsonl", project="char_lm"),
                 ],
@@ -67,6 +81,18 @@ def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
     )
     launcher.launch()
     print(f"vocab={tok.vocab_size} steps={total_steps}")
+
+    # Sample a continuation from the trained model (generate() recomputes
+    # the causal prefix inside one compiled fori_loop — no KV cache).
+    from rocket_tpu.models.transformer import generate
+
+    prompt = tok.encode("the ")[None, :]
+    max_new = min(64, config.max_seq_len - prompt.shape[1])
+    out = generate(
+        model, {"params": trained["params"], "state": {}}, prompt, max_new,
+        key=jax.random.key(0), temperature=0.8, top_k=20,
+    )
+    print("sample:", tok.decode(np.asarray(out[0])))
 
 
 if __name__ == "__main__":

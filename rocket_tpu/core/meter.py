@@ -213,3 +213,12 @@ class Metric(Capsule):
         once in ``reset``; a per-batch device_get here would put a D2H sync
         on the eval hot path."""
         raise NotImplementedError
+
+    def publish(self, attrs: Attributes | None, tag: str, value) -> None:
+        """Route a finalized scalar to the tracker buffers and the live loop
+        state (the reference example's reset shape, examples/mnist.py:20-39)."""
+        if attrs is not None:
+            if attrs.tracker is not None:
+                attrs.tracker.scalars[tag] = value
+            if attrs.looper is not None:
+                attrs.looper.state[tag] = value

@@ -14,6 +14,7 @@ sharded on the model axis), XLA inserting the collectives.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Optional
 
@@ -25,7 +26,7 @@ from rocket_tpu.nn.attention import MultiHeadAttention
 from rocket_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm
 from rocket_tpu.nn.module import Layer, Model, Variables
 
-__all__ = ["TransformerConfig", "TransformerLM", "Block", "next_token_loss"]
+__all__ = ["TransformerConfig", "TransformerLM", "Block", "next_token_loss", "generate"]
 
 
 @dataclass
@@ -262,3 +263,76 @@ def next_token_loss(
         ).mean()
 
     return objective
+
+
+def generate(
+    model: TransformerLM,
+    variables: Variables,
+    prompt_tokens,
+    max_new_tokens: int,
+    *,
+    key=None,
+    temperature: float = 1.0,
+    top_k: int = None,
+):
+    """Autoregressive sampling from a trained LM.
+
+    Recomputes the full (causal) prefix each step inside one compiled
+    ``fori_loop`` — a single executable for the whole generation, no
+    KV-cache state to manage. O(T^2) per token: right for demos and eval
+    loops, not for a serving stack.
+
+    ``temperature=0`` is greedy argmax (no key needed); otherwise pass a
+    PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens.
+    Returns (B, prompt_len + max_new_tokens) int32.
+    """
+    prompt = jnp.asarray(prompt_tokens, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    b, start = prompt.shape
+    total = start + max_new_tokens
+    if total > model.config.max_seq_len:
+        raise ValueError(
+            f"generate: prompt {start} + new {max_new_tokens} tokens exceed "
+            f"max_seq_len {model.config.max_seq_len}"
+        )
+    if temperature > 0 and key is None:
+        raise ValueError("generate: sampling (temperature > 0) needs a PRNG key")
+
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :start].set(prompt)
+    key = jax.random.key(0) if key is None else key
+    run = _generate_fn(model, start, total, float(temperature), top_k)
+    return run(variables["params"], buf, key)
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_fn(model, start, total, temperature, top_k):
+    """Jitted generation loop, cached by (model, window, sampling knobs) —
+    a fresh closure per generate() call would retrace and recompile the
+    whole model every invocation."""
+
+    @jax.jit
+    def run(params, buf, key):
+        def body(i, carry):
+            buf, key = carry
+            out, _ = model.apply(
+                {"params": params, "state": {}}, {model.tokens_key: buf},
+                mode="eval",
+            )
+            logits = jax.lax.dynamic_index_in_dim(
+                out[model.logits_key], i - 1, axis=1, keepdims=False
+            ).astype(jnp.float32)
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return buf.at[:, i].set(nxt.astype(jnp.int32)), key
+
+        buf, _ = jax.lax.fori_loop(start, total, body, (buf, key))
+        return buf
+
+    return run

@@ -19,13 +19,17 @@ Spec = Optional[Tuple]
 RuleFn = Callable[[Tuple[str, ...], object], Spec]
 
 
-def make_rules(rules: Sequence[Tuple[str, Spec]]) -> RuleFn:
+def make_rules(
+    rules: Sequence[Tuple[str, Spec]],
+    stacked_prefixes: Tuple[str, ...] = ("blocks_stacked",),
+) -> RuleFn:
     """Build a param_sharding fn from ``[(glob, spec), ...]``; first match
     wins; no match -> replicated (None).
 
-    Specs are written for a layer's natural rank; a leaf with EXTRA leading
-    dims (the stacked ``blocks_stacked`` layout of ``scan_layers``) gets the
-    spec left-padded with None so the same rule set serves both layouts.
+    Specs are written for a layer's natural rank. ONLY leaves under a
+    ``stacked_prefixes`` subtree (the scan-over-layers layout, which adds a
+    leading layer dim) get the spec left-padded with None — elsewhere a
+    short spec keeps JAX's usual meaning (missing TRAILING dims replicated).
     """
 
     def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
@@ -37,6 +41,8 @@ def make_rules(rules: Sequence[Tuple[str, Spec]]) -> RuleFn:
                     spec is not None
                     and shape is not None
                     and len(shape) > len(spec)
+                    and path
+                    and path[0] in stacked_prefixes
                 ):
                     spec = (None,) * (len(shape) - len(spec)) + tuple(spec)
                 return spec

@@ -127,3 +127,38 @@ def test_merge_batch_list_roundtrip():
     merged = _merge_batch(dynamic[:2], static)
     assert merged[2] is None or isinstance(merged[2], np.ndarray)
     assert merged[3] == 7
+
+
+def test_topk_accuracy_and_perplexity():
+    from rocket_tpu.utils.metrics import Perplexity, TopKAccuracy
+    import jax.numpy as jnp
+
+    # Top-2: rows 0,1 have the label in the top-2; row 2 doesn't; row 3 is
+    # padding (trimmed by size=3).
+    logits = np.array(
+        [[5.0, 4.0, 0, 0], [4.0, 5.0, 0, 0], [0, 0, 5.0, 4.0], [9.0, 0, 0, 0]],
+        np.float32,
+    )
+    labels = np.array([1, 0, 1, 0])
+    topk = TopKAccuracy(k=2)
+    meter = Meter(["logits", "label"], [topk])
+    attrs = Attributes()
+    attrs.batch = {"logits": jnp.asarray(logits), "label": jnp.asarray(labels)}
+    attrs.batch_info = Attributes(size=3)
+    meter.launch(attrs)
+    meter.reset(Attributes())
+    assert abs(topk.value - 2 / 3) < 1e-6
+
+    # Perplexity of a uniform predictor over V classes is V.
+    V, B, T = 8, 2, 5
+    ppl = Perplexity()
+    meter2 = Meter(["logits", "tokens"], [ppl])
+    attrs2 = Attributes()
+    attrs2.batch = {
+        "logits": jnp.zeros((B, T, V), jnp.float32),
+        "tokens": jnp.zeros((B, T), jnp.int32),
+    }
+    attrs2.batch_info = Attributes(size=B)
+    meter2.launch(attrs2)
+    meter2.reset(Attributes())
+    assert abs(ppl.value - V) < 1e-3

@@ -76,6 +76,41 @@ step_dir = os.path.join(ckpt_dir, "4", "model_0")
 assert os.path.exists(os.path.join(step_dir, f"shard_p{rank}.npz")), os.listdir(step_dir)
 if rank == 0:
     assert os.path.exists(os.path.join(step_dir, "index.json"))
+
+# Cross-process RESUME: a fresh tree restores the sharded checkpoint — each
+# host reads only the chunks its addressable shards need (plus the other
+# host's file for resharded regions) and lands on the saved step.
+model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
+module2 = rt.Module(
+    model2,
+    capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+)
+tree2 = rt.Launcher(
+    [
+        rt.Looper(
+            [
+                rt.Dataset(data, batch_size=32),
+                module2,
+                rt.Checkpointer(
+                    output_dir=ckpt_dir, save_every=1000,
+                    resume_from=os.path.join(ckpt_dir, "4"),
+                    resume_capsules=False,
+                ),
+            ],
+            tag="train",
+            progress=False,
+        )
+    ],
+    num_epochs=1,
+    runtime=runtime,
+)
+attrs = rt.Attributes()
+tree2.setup(attrs)
+import numpy as _np
+assert int(_np.asarray(module2.state["step"])) == 4, module2.state["step"]
+assert module2._prepared.host_step == 4
+tree2.destroy(attrs)
+runtime.wait_for_everyone()
 print(f"RANK{rank} OK", flush=True)
 """
 

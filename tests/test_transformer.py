@@ -248,3 +248,29 @@ def test_scan_layers_trains_with_tp_rules(tmp_path):
         runtime=runtime,
     ).launch()
     assert seen["ndim"] == 3 and "model" in seen["spec"], seen
+
+
+def test_generate_shapes_determinism_and_range():
+    from rocket_tpu.models.transformer import generate
+
+    config = tiny_config()
+    model = TransformerLM(config)
+    variables = model.init(jax.random.key(0))
+    prompt = np.array([[1, 2, 3]], np.int32)
+
+    greedy1 = generate(model, variables, prompt, 8, temperature=0)
+    greedy2 = generate(model, variables, prompt, 8, temperature=0)
+    assert greedy1.shape == (1, 11)
+    np.testing.assert_array_equal(np.asarray(greedy1), np.asarray(greedy2))
+    np.testing.assert_array_equal(np.asarray(greedy1[:, :3]), prompt)
+    assert int(jnp.max(greedy1)) < config.vocab_size and int(jnp.min(greedy1)) >= 0
+
+    s1 = generate(model, variables, prompt, 8, key=jax.random.key(1), top_k=8)
+    s2 = generate(model, variables, prompt, 8, key=jax.random.key(2), top_k=8)
+    assert s1.shape == (1, 11)
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))  # keys differ
+
+    with pytest.raises(ValueError, match="needs a PRNG key"):
+        generate(model, variables, prompt, 4)
+    with pytest.raises(ValueError, match="exceed"):
+        generate(model, variables, prompt, config.max_seq_len)
