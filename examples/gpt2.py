@@ -47,6 +47,10 @@ def main():
         "--scan-layers", action="store_true",
         help="lax.scan over stacked blocks (compiles one block, not 12)",
     )
+    parser.add_argument(
+        "--resume", action="store_true",
+        help="resume from the newest checkpoint (restart after preemption)",
+    )
     args = parser.parse_args()
 
     n_dev = len(jax.devices())
@@ -108,7 +112,8 @@ def main():
                         remat=not args.small,
                     ),
                     rt.Checkpointer(output_dir="checkpoints/gpt2", save_every=1000,
-                                    keep_last=3),
+                                    keep_last=3,
+                                    resume_from="latest" if args.resume else None),
                     # steps/sec + MFU in the tqdm postfix; optional trace.
                     rt.Profiler(
                         trace_start=args.trace_at,

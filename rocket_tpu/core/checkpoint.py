@@ -74,7 +74,66 @@ class Checkpointer(Capsule):
     def setup(self, attrs: Attributes | None = None) -> None:
         super().setup(attrs)
         if self._resume_from:
-            self._load(self._resume_from)
+            path = self._resolve_resume_path(self._resume_from)
+            if path is not None:
+                self._load(path)
+
+    def _resolve_resume_path(self, path: str) -> Optional[str]:
+        """``resume_from="latest"`` picks the newest COMPLETE step under
+        output_dir — the restart-after-preemption idiom (no step number to
+        thread through the relauncher). Returns None (fresh start, logged)
+        when no checkpoint exists yet, so a relauncher can always pass the
+        flag; an explicit path still raises if missing."""
+        if path != "latest":
+            return path
+        steps = (
+            sorted(
+                (int(d) for d in os.listdir(self._output_dir) if d.isdigit()),
+                reverse=True,
+            )
+            if os.path.isdir(self._output_dir)
+            else []
+        )
+        for step in steps:
+            candidate = os.path.join(self._output_dir, str(step))
+            if self._is_complete(candidate):
+                return candidate
+            self.log_warning(f"skipping incomplete checkpoint {candidate}")
+        self.log_info(
+            f"resume_from='latest': no complete checkpoint under "
+            f"{self._output_dir!r} — starting fresh."
+        )
+        return None
+
+    @staticmethod
+    def _is_complete(candidate: str) -> bool:
+        """A checkpoint is complete when the main process's LAST artifact
+        (rng.json) exists AND every shard file referenced by each model's
+        chunk index is on disk — a torn async write (preemption mid-save)
+        fails both per-host holes."""
+        if not os.path.exists(os.path.join(candidate, "rng.json")):
+            return False
+        for entry in os.listdir(candidate):
+            model_dir = os.path.join(candidate, entry)
+            if not (entry.startswith("model_") and os.path.isdir(model_dir)):
+                continue
+            index_path = os.path.join(model_dir, "index.json")
+            if not os.path.exists(index_path):
+                return False
+            with open(index_path, "r", encoding="utf-8") as f:
+                index = json.load(f)
+            files = {
+                chunk["file"]
+                for meta in index.values()
+                if meta.get("kind") == "array"
+                for chunk in meta["chunks"]
+            }
+            if any(
+                not os.path.exists(os.path.join(model_dir, name))
+                for name in files
+            ):
+                return False
+        return True
 
     def launch(self, attrs: Attributes | None = None) -> None:
         self._iter_idx += 1

@@ -54,6 +54,16 @@ class TransformerConfig:
     #: scan+remat recipe — per-layer granularity beats a whole-forward
     #: checkpoint). Only meaningful with scan_layers.
     scan_remat: bool = True
+    #: Mixture-of-Experts FFN: replace each block's dense MLP with
+    #: ``num_experts`` routed experts (``nn/moe.py``); 0 = dense. Shard the
+    #: stacked expert params over an 'expert' mesh axis with
+    #: ``parallel.sharding.moe_rules`` for expert parallelism.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    #: Aux load-balancing loss weight, surfaced as batch["moe_aux_loss"]
+    #: and added by ``next_token_loss``.
+    moe_aux_weight: float = 0.01
     #: Activation dtype for the trunk (e.g. "bfloat16"). The LM's input is
     #: int tokens, so ``Module(compute_dtype=...)``'s float-batch cast never
     #: fires — without this the f32 embedding gather silently promotes the
@@ -89,8 +99,19 @@ class Block(Layer):
             impl=c.attention_impl, seq_axis=c.seq_axis,
         )
         self.ln2 = LayerNorm(c.dim)
-        self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
-        self.fc_out = Dense(c.mlp_ratio * c.dim, c.dim)
+        if c.num_experts > 0:
+            from rocket_tpu.nn.moe import MoE
+
+            self.moe = MoE(
+                c.dim, c.mlp_ratio * c.dim, c.num_experts,
+                top_k=c.expert_top_k,
+                capacity_factor=c.expert_capacity_factor,
+            )
+            self.fc_in = self.fc_out = None
+        else:
+            self.moe = None
+            self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
+            self.fc_out = Dense(c.mlp_ratio * c.dim, c.dim)
         self.dropout = Dropout(c.dropout) if c.dropout else None
         # GPT-2: residual projections scaled by 1/sqrt(2*num_layers).
         self._resid_scale = (2 * c.num_layers) ** -0.5
@@ -102,14 +123,21 @@ class Block(Layer):
             "ln1": self.ln1.init(keys[0])["params"],
             "attn": self.attn.init(keys[1])["params"],
             "ln2": self.ln2.init(keys[2])["params"],
-            "mlp": {},
         }
-        k_in, k_out = jax.random.split(keys[3])
-        params["mlp"]["fc_in"] = self.fc_in.init(k_in)["params"]
-        params["mlp"]["fc_out"] = self.fc_out.init(k_out)["params"]
-        # Residual-output scaling (attn.proj and fc_out).
+        # Residual-output scaling (attn.proj and the FFN output kernel).
         params["attn"]["proj"]["w"] = params["attn"]["proj"]["w"] * self._resid_scale
-        params["mlp"]["fc_out"]["w"] = params["mlp"]["fc_out"]["w"] * self._resid_scale
+        if self.moe is not None:
+            params["moe"] = self.moe.init_params(keys[3])
+            params["moe"]["experts"]["w_out"] = (
+                params["moe"]["experts"]["w_out"] * self._resid_scale
+            )
+        else:
+            k_in, k_out = jax.random.split(keys[3])
+            params["mlp"] = {
+                "fc_in": self.fc_in.init(k_in)["params"],
+                "fc_out": self.fc_out.init(k_out)["params"],
+            }
+            params["mlp"]["fc_out"]["w"] = params["mlp"]["fc_out"]["w"] * self._resid_scale
         return params
 
     def apply(self, variables, x, *, mode="train", rng=None, layer_idx=None):
@@ -132,11 +160,23 @@ class Block(Layer):
         x = x + h
 
         h, _ = self.ln2.apply({"params": p["ln2"], "state": {}}, x)
-        h, _ = self.fc_in.apply({"params": p["mlp"]["fc_in"], "state": {}}, h)
-        h = jax.nn.gelu(h)
-        h, _ = self.fc_out.apply({"params": p["mlp"]["fc_out"], "state": {}}, h)
+        aux = None
+        if self.moe is not None:
+            h, moe_out = self.moe.apply({"params": p["moe"], "state": {}}, h)
+            aux = moe_out["aux_loss"]
+        else:
+            h, _ = self.fc_in.apply({"params": p["mlp"]["fc_in"], "state": {}}, h)
+            h = jax.nn.gelu(h)
+            h, _ = self.fc_out.apply({"params": p["mlp"]["fc_out"], "state": {}}, h)
         if self.dropout is not None:
             h, _ = self.dropout.apply({"params": {}, "state": {}}, h, mode=mode, rng=rngs[2])
+        if aux is not None:
+            # Namespaced INTO the state dict (not replacing it): the Layer
+            # contract keeps real state flowing; TransformerLM pops this
+            # transient before anything could persist it.
+            out_state = dict(variables["state"])
+            out_state["aux_loss"] = aux
+            return x + h, out_state
         return x + h, variables["state"]
 
 
@@ -210,29 +250,36 @@ class TransformerLM(Model):
                 rng=None if rng is None else jax.random.fold_in(rng, 0x0E0BED),
             )
 
+        moe = self.config.num_experts > 0
+        aux_total = jnp.zeros((), jnp.float32) if moe else None
         if self.config.scan_layers:
             block = self.blocks[0]  # one traced body serves every layer
 
             def body(carry, xs):
                 params_i, i = xs
-                y, _ = block.apply(
-                    {"params": params_i, "state": {}}, carry,
+                h, aux = carry
+                y, bstate = block.apply(
+                    {"params": params_i, "state": {}}, h,
                     mode=mode, rng=rng, layer_idx=i,
                 )
-                return y, None
+                if moe:
+                    aux = aux + bstate["aux_loss"]
+                return (y, aux), None
 
             if self.config.scan_remat:
                 body = jax.checkpoint(body)
-            x, _ = jax.lax.scan(
+            (x, aux_total), _ = jax.lax.scan(
                 body,
-                x,
+                (x, aux_total),
                 (p["blocks_stacked"], jnp.arange(self.config.num_layers)),
             )
         else:
             for i, block in enumerate(self.blocks):
-                x, _ = block.apply(
+                x, bstate = block.apply(
                     {"params": p["blocks"][str(i)], "state": {}}, x, mode=mode, rng=rng
                 )
+                if moe:
+                    aux_total = aux_total + bstate["aux_loss"]
 
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
         if self.head is not None:
@@ -246,21 +293,29 @@ class TransformerLM(Model):
 
         out = dict(batch)
         out[self.logits_key] = logits
+        if moe:
+            # Pre-weighted router load-balancing loss; next_token_loss adds
+            # it when present.
+            out["moe_aux_loss"] = aux_total * self.config.moe_aux_weight
         return out, variables["state"]
 
 
 def next_token_loss(
     logits_key: str = "logits", tokens_key: str = "tokens"
 ):
-    """Objective: mean cross-entropy of logits[:, :-1] vs tokens[:, 1:]."""
+    """Objective: mean cross-entropy of logits[:, :-1] vs tokens[:, 1:],
+    plus the model's (pre-weighted) MoE load-balancing aux loss if the batch
+    carries one."""
     import optax
 
     def objective(batch):
         logits = batch[logits_key][:, :-1]
         targets = batch[tokens_key][:, 1:]
-        return optax.softmax_cross_entropy_with_integer_labels(
+        loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
         ).mean()
+        aux = batch["moe_aux_loss"] if "moe_aux_loss" in batch else None
+        return loss if aux is None else loss + aux
 
     return objective
 

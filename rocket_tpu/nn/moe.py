@@ -1,0 +1,139 @@
+"""Mixture-of-Experts FFN — expert parallelism over a mesh axis.
+
+GShard/Switch-style top-k routing with a fixed per-expert capacity
+(reference carries no MoE — this is north-star scale-out surface):
+
+* router logits -> top-k gates, renormalized over the chosen experts;
+* tokens take a slot in their expert up to ``capacity = tokens/E *
+  capacity_factor`` (overflow tokens drop to the residual path — standard
+  Switch behavior);
+* dispatch/combine are einsums against a (S, E, C) one-hot, so the whole
+  layer is jit-compatible with static shapes;
+* expert params are STACKED with a leading E dim. Declare
+  ``moe_rules(axis="expert")`` (parallel/sharding.py) to shard them over an
+  'expert' mesh axis — GSPMD then lowers the dispatch/combine einsums to
+  all-to-alls over ICI, which IS expert parallelism; no collective is
+  written by hand.
+
+The router's load-balancing auxiliary loss (mean gate fraction x mean
+dispatch fraction x E, GShard eq. 4) is returned to the caller; the model
+surfaces it in the output batch for the objective to add.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.nn.layers import Dense
+from rocket_tpu.nn.module import Layer
+
+__all__ = ["MoE"]
+
+
+class MoE(Layer):
+    """Top-k routed expert FFN (drop-in for the dense MLP in a block).
+
+    Input (B, T, D) -> output (B, T, D) plus a scalar aux loss.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        hidden: int,
+        num_experts: int,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+    ):
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(
+                f"MoE: top_k {top_k} must be in [1, num_experts={num_experts}]"
+            )
+        self.dim = dim
+        self.hidden = hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.router = Dense(dim, num_experts, use_bias=False)
+
+    def init_params(self, key):
+        k_r, k_in, k_out = jax.random.split(key, 3)
+        e, d, h = self.num_experts, self.dim, self.hidden
+        scale_in = d ** -0.5
+        scale_out = h ** -0.5
+        return {
+            "router": self.router.init(k_r)["params"],
+            "experts": {
+                "w_in": jax.random.normal(k_in, (e, d, h)) * scale_in,
+                "b_in": jnp.zeros((e, h)),
+                "w_out": jax.random.normal(k_out, (e, h, d)) * scale_out,
+                "b_out": jnp.zeros((e, d)),
+            },
+        }
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        b, t, d = x.shape
+        e = self.num_experts
+        s = b * t
+        tokens = x.reshape(s, d)
+
+        # -- routing (f32 end-to-end: a bf16 router matmul flips near-tied
+        # experts; the Switch/GShard lineage mandates f32 here) ------------
+        logits = tokens.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)  # (S, E)
+        top_gates, top_idx = jax.lax.top_k(gates, self.top_k)  # (S, K)
+        top_gates = top_gates / jnp.maximum(
+            jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9
+        )
+
+        capacity = max(1, int(self.capacity_factor * s * self.top_k / e))
+
+        # Slot assignment: for the k-th choice of each token, its position
+        # within the chosen expert = how many earlier (token, choice) pairs
+        # picked that expert. Choices are ranked k-major so primary routes
+        # win slots before secondary ones.
+        flat_idx = top_idx.T.reshape(-1)  # (K*S,) k-major
+        choice_onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)  # (K*S, E)
+        position = (
+            jnp.cumsum(choice_onehot, axis=0) - choice_onehot
+        )  # pairs before this one, per expert
+        slot = jnp.sum(position * choice_onehot, axis=-1)  # (K*S,)
+        keep = slot < capacity
+
+        # Dispatch/combine tensors (S, E, C).
+        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype) * keep[
+            :, None
+        ].astype(x.dtype)  # (K*S, C)
+        dispatch_kc = (
+            choice_onehot.astype(x.dtype)[:, :, None] * slot_onehot[:, None, :]
+        ).reshape(self.top_k, s, e, capacity)
+        dispatch = jnp.sum(dispatch_kc, axis=0)  # (S, E, C) 0/1
+        combine = jnp.sum(
+            dispatch_kc
+            * top_gates.T.reshape(self.top_k, s, 1, 1).astype(x.dtype),
+            axis=0,
+        )  # (S, E, C) gate-weighted
+
+        # -- expert computation (E batched; shard E over 'expert') --------
+        ex = p["experts"]
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch, tokens)
+        h = jnp.einsum("ecd,edh->ech", expert_in, ex["w_in"].astype(x.dtype))
+        h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, ex["w_out"].astype(x.dtype))
+        out = out + ex["b_out"].astype(x.dtype)[:, None, :]
+        y = jnp.einsum("sec,ecd->sd", combine, out).reshape(b, t, d)
+
+        # -- load-balancing aux loss (GShard eq. 4) -----------------------
+        primary = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
+        fraction_routed = jnp.mean(primary, axis=0)  # tokens per expert
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = e * jnp.sum(fraction_routed * mean_gate)
+
+        return y, {"aux_loss": aux}
+
+    def __repr__(self):
+        return (
+            f"MoE(d={self.dim}, h={self.hidden}, E={self.num_experts}, "
+            f"k={self.top_k})"
+        )

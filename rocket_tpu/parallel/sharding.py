@@ -13,7 +13,13 @@ from __future__ import annotations
 import fnmatch
 from typing import Callable, Optional, Sequence, Tuple
 
-__all__ = ["make_rules", "gpt2_tp_rules", "fsdp_rules"]
+__all__ = [
+    "make_rules",
+    "gpt2_tp_rules",
+    "fsdp_rules",
+    "moe_rules",
+    "combine_rules",
+]
 
 Spec = Optional[Tuple]
 RuleFn = Callable[[Tuple[str, ...], object], Spec]
@@ -94,5 +100,41 @@ def fsdp_rules(
         if path and path[0] in stacked_prefixes and len(shape) > 1:
             spec = (None, axis) + (None,) * (len(shape) - 2)
         return spec
+
+    return rule_fn
+
+
+def moe_rules(
+    axis: str = "expert",
+    stacked_prefixes: Tuple[str, ...] = ("blocks_stacked",),
+) -> RuleFn:
+    """Expert parallelism: stacked expert params (leading E dim, see
+    ``nn/moe.py``) sharded over an 'expert' mesh axis — GSPMD lowers the
+    MoE dispatch/combine einsums to all-to-alls over ICI. Composes with
+    other rule sets via :func:`combine_rules`."""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        if "experts" not in path:
+            return None
+        shape = getattr(leaf, "shape", ())
+        offset = 1 if path and path[0] in stacked_prefixes else 0
+        if len(shape) <= offset:
+            return None
+        return (None,) * offset + (axis,) + (None,) * (len(shape) - offset - 1)
+
+    return rule_fn
+
+
+def combine_rules(*fns: RuleFn) -> RuleFn:
+    """First rule set returning a non-None spec wins — e.g.
+    ``combine_rules(moe_rules(), gpt2_tp_rules())`` gives expert-parallel
+    FFNs with tensor-parallel attention."""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        for fn in fns:
+            spec = fn(path, leaf)
+            if spec is not None:
+                return spec
+        return None
 
     return rule_fn

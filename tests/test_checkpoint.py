@@ -279,3 +279,48 @@ def test_mid_epoch_resume_with_device_cache(tmp_path):
     assert len(seen2) == len(seen1) - 3
     for a, b in zip(seen2, seen1[3:]):
         np.testing.assert_array_equal(a, b)
+
+
+def test_resume_from_latest(tmp_path):
+    """resume_from="latest" restores the newest complete checkpoint — the
+    restart-after-preemption idiom."""
+    data = make_dataset()
+    ckpt = str(tmp_path / "ckpts")
+    runtime1 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model1 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree1, _ = build(runtime1, model1, data, ckpt, num_epochs=1)
+    tree1.launch()  # writes steps 4 and 8
+
+    runtime2 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree2, module2 = build(
+        runtime2, model2, data, ckpt, num_epochs=2, resume_from="latest"
+    )
+    attrs = rt.Attributes()
+    tree2.setup(attrs)
+    assert int(np.asarray(module2.state["step"])) == 8
+    tree2.destroy(attrs)
+
+    # No checkpoint yet -> fresh start (a relauncher can ALWAYS pass
+    # resume_from="latest"); a torn step dir is skipped for the previous
+    # complete one.
+    runtime3 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model3 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree3, module3 = build(
+        runtime3, model3, data, str(tmp_path / "nope"), num_epochs=1,
+        resume_from="latest",
+    )
+    tree3.setup(rt.Attributes())  # no raise
+    assert int(np.asarray(module3.state["step"])) == 0
+    tree3.destroy(rt.Attributes())
+
+    # Tear step 8 (delete its rng.json) -> "latest" falls back to step 4.
+    os.remove(os.path.join(ckpt, "8", "rng.json"))
+    runtime4 = Runtime(mesh_shape={"data": 8}, project_dir=str(tmp_path))
+    model4 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    tree4, module4 = build(
+        runtime4, model4, data, ckpt, num_epochs=2, resume_from="latest"
+    )
+    tree4.setup(rt.Attributes())
+    assert int(np.asarray(module4.state["step"])) == 4
+    tree4.destroy(rt.Attributes())
