@@ -94,16 +94,34 @@ class Checkpointer(Capsule):
             if os.path.isdir(self._output_dir)
             else []
         )
+        chosen = -1
         for step in steps:
             candidate = os.path.join(self._output_dir, str(step))
             if self._is_complete(candidate):
-                return candidate
+                chosen = step
+                break
             self.log_warning(f"skipping incomplete checkpoint {candidate}")
-        self.log_info(
-            f"resume_from='latest': no complete checkpoint under "
-            f"{self._output_dir!r} — starting fresh."
-        )
-        return None
+
+        # Multi-host: every process must restore the SAME step — a stale
+        # filesystem view (NFS attribute cache after a fast restart) could
+        # otherwise pick different steps per host and silently diverge.
+        # The main process's choice is broadcast to everyone.
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            chosen = int(
+                multihost_utils.broadcast_one_to_all(np.int64(chosen))
+            )
+
+        if chosen < 0:
+            self.log_info(
+                f"resume_from='latest': no complete checkpoint under "
+                f"{self._output_dir!r} — starting fresh."
+            )
+            return None
+        return os.path.join(self._output_dir, str(chosen))
 
     @staticmethod
     def _is_complete(candidate: str) -> bool:

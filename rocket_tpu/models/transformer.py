@@ -54,6 +54,12 @@ class TransformerConfig:
     #: scan+remat recipe — per-layer granularity beats a whole-forward
     #: checkpoint). Only meaningful with scan_layers.
     scan_remat: bool = True
+    #: Pipeline parallelism: run the (scan_layers-stacked) blocks as GPipe
+    #: stages over this mesh axis (``parallel/pipeline.py``); shard the
+    #: stacked params with ``parallel.sharding.pipeline_rules``. Requires
+    #: scan_layers and num_layers divisible by the axis size.
+    pipeline_axis: Optional[str] = None
+    pipeline_microbatches: Optional[int] = None
     #: Mixture-of-Experts FFN: replace each block's dense MLP with
     #: ``num_experts`` routed experts (``nn/moe.py``); 0 = dense. Shard the
     #: stacked expert params over an 'expert' mesh axis with
@@ -203,6 +209,7 @@ class TransformerLM(Model):
         self.drop = Dropout(config.dropout) if config.dropout else None
         self.tokens_key = tokens_key
         self.logits_key = logits_key
+        self._pipe_mesh = None  # pinned at first pipelined trace
 
     def init(self, key: jax.Array) -> Variables:
         keys = jax.random.split(key, len(self.blocks) + 3)
@@ -228,6 +235,62 @@ class TransformerLM(Model):
     def num_params(self, variables: Variables) -> int:
         return sum(int(l.size) for l in jax.tree.leaves(variables["params"]))
 
+    def _apply_pipelined(self, p, x, *, mode, rng):
+        """Trunk via GPipe stages over config.pipeline_axis
+        (``parallel/pipeline.py``). Requires the scan_layers stacked layout;
+        the mesh is pinned at first trace (same rule as ring attention)."""
+        c = self.config
+        if not c.scan_layers:
+            raise RuntimeError(
+                "TransformerConfig.pipeline_axis requires scan_layers=True "
+                "(stacked block params are the pipeline stages)."
+            )
+        if c.num_experts > 0:
+            raise RuntimeError(
+                "pipeline_axis with num_experts (MoE aux loss through the "
+                "pipeline carry) is not supported yet."
+            )
+        if self._pipe_mesh is None:
+            from rocket_tpu.runtime.context import Runtime
+
+            runtime = Runtime.current()
+            if runtime is None or c.pipeline_axis not in runtime.mesh.shape:
+                raise RuntimeError(
+                    f"pipeline_axis={c.pipeline_axis!r} needs a live Runtime "
+                    "whose mesh has that axis (e.g. Runtime(mesh_shape="
+                    "{'data': 2, 'pipe': 4}))."
+                )
+            self._pipe_mesh = runtime.mesh
+        from rocket_tpu.parallel.pipeline import pipeline_blocks
+
+        block = self.blocks[0]
+        has_data = "data" in self._pipe_mesh.shape
+
+        def block_apply(params_i, idx, mb, h):
+            r = rng
+            if r is not None:
+                # Distinct dropout masks per microbatch AND per data shard —
+                # one shared key would correlate every microbatch's mask.
+                r = jax.random.fold_in(r, mb)
+                if has_data:
+                    r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            y, _ = block.apply(
+                {"params": params_i, "state": {}}, h,
+                mode=mode, rng=r, layer_idx=idx,
+            )
+            return y
+
+        return pipeline_blocks(
+            block_apply,
+            p["blocks_stacked"],
+            x,
+            mesh=self._pipe_mesh,
+            pipe_axis=c.pipeline_axis,
+            data_axis="data",
+            num_microbatches=c.pipeline_microbatches,
+            remat=c.scan_remat,
+        )
+
     def apply(self, variables, batch, *, mode="train", rng=None):
         p = variables["params"]
         tokens = batch[self.tokens_key]
@@ -252,7 +315,9 @@ class TransformerLM(Model):
 
         moe = self.config.num_experts > 0
         aux_total = jnp.zeros((), jnp.float32) if moe else None
-        if self.config.scan_layers:
+        if self.config.pipeline_axis:
+            x = self._apply_pipelined(p, x, mode=mode, rng=rng)
+        elif self.config.scan_layers:
             block = self.blocks[0]  # one traced body serves every layer
 
             def body(carry, xs):
@@ -282,6 +347,7 @@ class TransformerLM(Model):
                     aux_total = aux_total + bstate["aux_loss"]
 
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
+        # (pipeline path skips the MoE aux loss — see _apply_pipelined)
         if self.head is not None:
             logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
         else:
@@ -293,7 +359,7 @@ class TransformerLM(Model):
 
         out = dict(batch)
         out[self.logits_key] = logits
-        if moe:
+        if moe and not self.config.pipeline_axis:
             # Pre-weighted router load-balancing loss; next_token_loss adds
             # it when present.
             out["moe_aux_loss"] = aux_total * self.config.moe_aux_weight

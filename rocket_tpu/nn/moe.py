@@ -4,11 +4,11 @@ GShard/Switch-style top-k routing with a fixed per-expert capacity
 (reference carries no MoE — this is north-star scale-out surface):
 
 * router logits -> top-k gates, renormalized over the chosen experts;
-* tokens take a slot in their expert up to ``capacity = tokens/E *
-  capacity_factor`` (overflow tokens drop to the residual path — standard
-  Switch behavior);
-* dispatch/combine are einsums against a (S, E, C) one-hot, so the whole
-  layer is jit-compatible with static shapes;
+* routing is GROUPED per batch row (GShard groups): each row's tokens take
+  a slot in their expert up to ``capacity = cf * k * T / E`` (overflow
+  tokens drop to the residual path — standard Switch behavior);
+* dispatch/combine are einsums against a (B, T, E, C) one-hot — O(B*T^2)
+  memory, jit-compatible static shapes;
 * expert params are STACKED with a leading E dim. Declare
   ``moe_rules(axis="expert")`` (parallel/sharding.py) to shard them over an
   'expert' mesh axis — GSPMD then lowers the dispatch/combine einsums to
@@ -74,60 +74,61 @@ class MoE(Layer):
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
         b, t, d = x.shape
-        e = self.num_experts
-        s = b * t
-        tokens = x.reshape(s, d)
+        e, k = self.num_experts, self.top_k
 
         # -- routing (f32 end-to-end: a bf16 router matmul flips near-tied
         # experts; the Switch/GShard lineage mandates f32 here) ------------
-        logits = tokens.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
-        gates = jax.nn.softmax(logits, axis=-1)  # (S, E)
-        top_gates, top_idx = jax.lax.top_k(gates, self.top_k)  # (S, K)
+        logits = x.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)  # (B, T, E)
+        top_gates, top_idx = jax.lax.top_k(gates, k)  # (B, T, K)
         top_gates = top_gates / jnp.maximum(
             jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9
         )
 
-        capacity = max(1, int(self.capacity_factor * s * self.top_k / e))
+        # GShard-style GROUPED routing: each batch row is a routing group
+        # with its own capacity, so the dispatch one-hots are
+        # (B, T, E, C=cf*k*T/E) — O(B*T^2) elements rather than the
+        # O((B*T)^2) an ungrouped formulation costs at scale.
+        capacity = max(1, int(self.capacity_factor * t * k / e))
 
-        # Slot assignment: for the k-th choice of each token, its position
-        # within the chosen expert = how many earlier (token, choice) pairs
-        # picked that expert. Choices are ranked k-major so primary routes
-        # win slots before secondary ones.
-        flat_idx = top_idx.T.reshape(-1)  # (K*S,) k-major
-        choice_onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)  # (K*S, E)
-        position = (
-            jnp.cumsum(choice_onehot, axis=0) - choice_onehot
-        )  # pairs before this one, per expert
-        slot = jnp.sum(position * choice_onehot, axis=-1)  # (K*S,)
+        # Slot assignment per group: a (token, choice) pair's position in
+        # its expert = earlier pairs in the group that chose that expert.
+        # Choices are ranked k-major so primary routes win slots first.
+        flat_idx = jnp.swapaxes(top_idx, 1, 2).reshape(b, k * t)  # k-major
+        choice_onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)  # (B, K*T, E)
+        position = jnp.cumsum(choice_onehot, axis=1) - choice_onehot
+        slot = jnp.sum(position * choice_onehot, axis=-1)  # (B, K*T)
         keep = slot < capacity
 
-        # Dispatch/combine tensors (S, E, C).
+        # Dispatch/combine tensors (B, T, E, C).
         slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype) * keep[
-            :, None
-        ].astype(x.dtype)  # (K*S, C)
+            ..., None
+        ].astype(x.dtype)  # (B, K*T, C)
         dispatch_kc = (
-            choice_onehot.astype(x.dtype)[:, :, None] * slot_onehot[:, None, :]
-        ).reshape(self.top_k, s, e, capacity)
-        dispatch = jnp.sum(dispatch_kc, axis=0)  # (S, E, C) 0/1
+            choice_onehot.astype(x.dtype)[..., :, None]
+            * slot_onehot[..., None, :]
+        ).reshape(b, k, t, e, capacity)
+        dispatch = jnp.sum(dispatch_kc, axis=1)  # (B, T, E, C) 0/1
         combine = jnp.sum(
             dispatch_kc
-            * top_gates.T.reshape(self.top_k, s, 1, 1).astype(x.dtype),
-            axis=0,
-        )  # (S, E, C) gate-weighted
+            * jnp.swapaxes(top_gates, 1, 2)[..., None, None].astype(x.dtype),
+            axis=1,
+        )  # (B, T, E, C) gate-weighted
 
-        # -- expert computation (E batched; shard E over 'expert') --------
+        # -- expert computation (E leading; shard E over 'expert' — GSPMD
+        # lowers the dispatch/combine einsums to all-to-alls) -------------
         ex = p["experts"]
-        expert_in = jnp.einsum("sec,sd->ecd", dispatch, tokens)
-        h = jnp.einsum("ecd,edh->ech", expert_in, ex["w_in"].astype(x.dtype))
-        h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[:, None, :])
-        out = jnp.einsum("ech,ehd->ecd", h, ex["w_out"].astype(x.dtype))
-        out = out + ex["b_out"].astype(x.dtype)[:, None, :]
-        y = jnp.einsum("sec,ecd->sd", combine, out).reshape(b, t, d)
+        expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
+        h = jnp.einsum("ebcd,edh->ebch", expert_in, ex["w_in"].astype(x.dtype))
+        h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[:, None, None, :])
+        out = jnp.einsum("ebch,ehd->ebcd", h, ex["w_out"].astype(x.dtype))
+        out = out + ex["b_out"].astype(x.dtype)[:, None, None, :]
+        y = jnp.einsum("btec,ebcd->btd", combine, out)
 
         # -- load-balancing aux loss (GShard eq. 4) -----------------------
-        primary = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
-        fraction_routed = jnp.mean(primary, axis=0)  # tokens per expert
-        mean_gate = jnp.mean(gates, axis=0)
+        primary = jax.nn.one_hot(top_idx[..., 0], e, dtype=jnp.float32)
+        fraction_routed = jnp.mean(primary, axis=(0, 1))  # tokens per expert
+        mean_gate = jnp.mean(gates, axis=(0, 1))
         aux = e * jnp.sum(fraction_routed * mean_gate)
 
         return y, {"aux_loss": aux}

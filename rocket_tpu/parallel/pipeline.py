@@ -1,0 +1,150 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over a mesh axis.
+
+The transformer trunk's stacked layer params (``scan_layers`` layout,
+leading L dim) are sharded over a 'pipe' mesh axis: stage ``s`` holds layers
+``[s*L/P, (s+1)*L/P)``. Microbatches flow through the stages inside ONE
+``shard_map``: each tick every stage runs its local layers on its current
+microbatch and ``ppermute``s the activations to the next stage, so after
+``M + P - 1`` ticks all ``M`` microbatches have crossed all ``P`` stages —
+the classic fill/steady/drain schedule, compiled into a single XLA program
+with the inter-stage transfers on ICI.
+
+Differentiation is automatic: the tick loop is a ``lax.scan`` and
+``ppermute`` is differentiable, so ``jax.grad`` of a loss through
+:func:`pipeline_blocks` yields the reverse pipeline schedule. Each stage
+body is rematerialized (``jax.checkpoint``) — the standard memory/compute
+trade at pipeline scale.
+
+Bubble fraction is ``(P-1)/(M+P-1)``; pick ``num_microbatches >= P``
+(default ``2*P``) to amortize it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 moved shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["pipeline_blocks"]
+
+
+def pipeline_blocks(
+    block_apply: Callable,
+    stacked_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = "data",
+    num_microbatches: Optional[int] = None,
+    remat: bool = True,
+):
+    """Run ``x`` (B, T, D) through L stacked layers pipelined over
+    ``pipe_axis``.
+
+    ``block_apply(layer_params, global_layer_idx, microbatch_idx, h) -> h``
+    is one layer — fold any dropout rng by BOTH indices (plus the data-shard
+    ``axis_index``), or every microbatch reuses one mask.
+    ``stacked_params`` is the (L, ...) pytree with L sharded over
+    ``pipe_axis`` (and L divisible by the axis size). The batch dim may be
+    sharded over ``data_axis``; activations are replicated over the pipe
+    axis outside the shard_map.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"pipeline: {num_layers} layers must divide over {n_stages} "
+            f"pipeline stages."
+        )
+    layers_per_stage = num_layers // n_stages
+    m = num_microbatches or 2 * n_stages
+    batch = x.shape[0]
+    # The batch is split per data-shard, so each shard needs m | B/shards.
+    data_shards = mesh.shape[data_axis] if (data_axis and data_axis in mesh.shape) else 1
+    if (batch // data_shards) % m:
+        raise ValueError(
+            f"pipeline: per-shard batch {batch // data_shards} must divide "
+            f"into {m} microbatches."
+        )
+
+    batch_spec = P(data_axis if data_shards > 1 else None, None, None)
+    param_spec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+
+    def stage_fn(local_params, x_local):
+        s = jax.lax.axis_index(pipe_axis)
+        b_local = x_local.shape[0]
+        micro = x_local.reshape(m, b_local // m, *x_local.shape[1:])
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def run_stage(h, mb):
+            def layer(h, xs):
+                params_i, local_i = xs
+                return (
+                    block_apply(params_i, s * layers_per_stage + local_i, mb, h),
+                    None,
+                )
+
+            h, _ = jax.lax.scan(
+                layer, h, (local_params, jnp.arange(layers_per_stage))
+            )
+            return h
+
+        if remat:
+            run_stage = jax.checkpoint(run_stage)
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # Microbatch this stage works on at tick t (clipped during
+            # fill/drain, where the compute is masked out anyway).
+            mb = jnp.clip(t - s, 0, m - 1)
+            feed = micro[jnp.clip(t, 0, m - 1)]
+            h = jnp.where(s == 0, feed, incoming)
+            y = run_stage(h, mb)
+            incoming = jax.lax.ppermute(y, pipe_axis, perm)
+            out_idx = t - (n_stages - 1)
+            write = (s == n_stages - 1) & (out_idx >= 0) & (out_idx < m)
+            idx = jnp.clip(out_idx, 0, m - 1)
+            outputs = outputs.at[idx].set(
+                jnp.where(write, y, outputs[idx])
+            )
+            return (incoming, outputs), None
+
+        outputs = jnp.zeros_like(micro)
+        incoming = jnp.zeros_like(micro[0])
+        # The carries become pipe-varying after one tick (they depend on the
+        # stage index); mark the zero-initialized constants accordingly so
+        # the scan carry types match (jax vma checking).
+        if hasattr(jax.lax, "pcast"):
+            incoming = jax.lax.pcast(incoming, pipe_axis, to="varying")
+            outputs = jax.lax.pcast(outputs, pipe_axis, to="varying")
+        elif hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax
+            incoming = jax.lax.pvary(incoming, (pipe_axis,))
+            outputs = jax.lax.pvary(outputs, (pipe_axis,))
+        (_, outputs), _ = jax.lax.scan(
+            tick, (incoming, outputs), jnp.arange(m + n_stages - 1)
+        )
+        # Only the last stage holds real outputs; broadcast them to every
+        # stage so the result is pipe-invariant (one (B,T,D) psum on ICI).
+        outputs = jax.lax.psum(
+            jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            pipe_axis,
+        )
+        return outputs.reshape(b_local, *x_local.shape[1:])
+
+    fn = shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(param_spec, batch_spec),
+        out_specs=batch_spec,
+    )
+    # jit wrapper: the remat'ed stage body can't evaluate eagerly inside
+    # shard_map; under an outer jit (the normal train step) this inlines.
+    return jax.jit(fn)(stacked_params, x)

@@ -18,6 +18,7 @@ __all__ = [
     "gpt2_tp_rules",
     "fsdp_rules",
     "moe_rules",
+    "pipeline_rules",
     "combine_rules",
 ]
 
@@ -135,6 +136,25 @@ def combine_rules(*fns: RuleFn) -> RuleFn:
             spec = fn(path, leaf)
             if spec is not None:
                 return spec
+        return None
+
+    return rule_fn
+
+
+def pipeline_rules(
+    axis: str = "pipe",
+    stacked_prefix: str = "blocks_stacked",
+) -> RuleFn:
+    """Pipeline parallelism: the stacked layer dim (scan_layers layout)
+    sharded over a 'pipe' mesh axis — each stage holds its layer slice
+    (``parallel/pipeline.py`` runs the GPipe schedule over it). Embeddings /
+    head stay replicated; compose with other rule sets via
+    :func:`combine_rules`."""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        if path and path[0] == stacked_prefix:
+            shape = getattr(leaf, "shape", ())
+            return (axis,) + (None,) * (len(shape) - 1)
         return None
 
     return rule_fn
