@@ -241,12 +241,57 @@ BENCHES = {
 }
 
 
+def _require_live_backend(headline_metric: str, timeout_s: float = 120.0) -> None:
+    """Fail fast (one JSON error line) when the device backend is
+    unreachable — the tunneled TPU goes down for hours at a time, and a
+    hung jax.devices() would otherwise stall the whole bench run."""
+    import threading
+
+    ok = threading.Event()
+
+    def probe():
+        try:
+            jax.devices()
+            ok.set()
+        except Exception:
+            pass
+
+    thread = threading.Thread(target=probe, daemon=True)
+    thread.start()
+    thread.join(timeout_s)
+    if not ok.is_set():
+        print(
+            json.dumps(
+                {
+                    "metric": headline_metric,
+                    "error": f"device backend unreachable after {timeout_s:.0f}s",
+                }
+            ),
+            flush=True,
+        )
+        import os
+
+        os._exit(1)
+
+
+#: Headline metric name per config (error reporting when the backend is down).
+METRIC_NAMES = {
+    "gpt2": "gpt2_124m_tok_per_sec_per_chip",
+    "charlm": "charlm_tok_per_sec_per_chip",
+    "resnet18": "cifar_resnet18_samples_per_sec_per_chip",
+    "mlp": "mnist_mlp_samples_per_sec_per_chip",
+}
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", default="all", choices=["all", *BENCHES.keys()]
     )
     args = parser.parse_args()
+    _require_live_backend(
+        METRIC_NAMES["gpt2" if args.config == "all" else args.config]
+    )
 
     names = list(BENCHES) if args.config == "all" else [args.config]
     results = {}

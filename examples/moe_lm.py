@@ -1,0 +1,95 @@
+"""Mixture-of-Experts char-LM with expert parallelism.
+
+Each block's MLP is replaced by a top-2 routed expert FFN (``nn/moe.py``);
+on a mesh with an 'expert' axis the stacked expert params are sharded over
+it (``moe_rules``) and GSPMD lowers the dispatch/combine einsums to
+all-to-alls over ICI. On one chip the same program runs with every expert
+local. The router's load-balancing aux loss rides batch["moe_aux_loss"]
+into ``next_token_loss`` automatically.
+
+Try it on the virtual mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'PY'
+import jax; jax.config.update("jax_platforms", "cpu")
+import runpy; runpy.run_path("examples/moe_lm.py", run_name="__main__")
+PY``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.text import CharTokenizer, TokenDataset, tiny_shakespeare
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    next_token_loss,
+)
+from rocket_tpu.parallel.sharding import moe_rules
+
+
+def main(num_epochs: int = 2, batch_size: int = 64, seq_len: int = 128):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--experts", type=int, default=4)
+    parser.add_argument("--expert-axis", type=int, default=None,
+                        help="mesh devices on the 'expert' axis (default: all)")
+    args, _ = parser.parse_known_args()
+
+    n_dev = len(jax.devices())
+    # Default: widest expert axis that divides both the device count and E.
+    expert_devices = args.expert_axis or max(
+        w for w in range(1, n_dev + 1)
+        if n_dev % w == 0 and args.experts % w == 0
+    )
+    if n_dev % expert_devices or args.experts % expert_devices:
+        raise SystemExit(
+            f"--expert-axis {expert_devices} must divide both {n_dev} "
+            f"devices and {args.experts} experts"
+        )
+    runtime = rt.Runtime(
+        mesh_shape={"data": n_dev // expert_devices, "expert": expert_devices},
+        seed=0,
+    )
+
+    text = tiny_shakespeare()
+    tok = CharTokenizer(text)
+    data = TokenDataset(tok.encode(text), seq_len=seq_len)
+
+    config = TransformerConfig(
+        vocab_size=tok.vocab_size, max_seq_len=seq_len, dim=128,
+        num_layers=4, num_heads=4, dropout=0.0,
+        num_experts=args.experts, expert_top_k=2,
+    )
+    model = TransformerLM(config)
+
+    rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(data, batch_size=batch_size, shuffle=True,
+                               drop_last=True),
+                    rt.Module(
+                        model,
+                        capsules=[
+                            rt.Loss(next_token_loss()),
+                            rt.Optimizer(optim.adamw(), learning_rate=1e-3),
+                        ],
+                        param_sharding=moe_rules(),
+                    ),
+                    rt.Profiler(),
+                ],
+                tag="train",
+            )
+        ],
+        num_epochs=num_epochs,
+        runtime=runtime,
+    ).launch()
+
+
+if __name__ == "__main__":
+    main()

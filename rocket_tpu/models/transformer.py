@@ -210,6 +210,7 @@ class TransformerLM(Model):
         self.tokens_key = tokens_key
         self.logits_key = logits_key
         self._pipe_mesh = None  # pinned at first pipelined trace
+        self._pipe_block_apply: dict = {}  # mode -> stable pipeline body
 
     def init(self, key: jax.Array) -> Variables:
         keys = jax.random.split(key, len(self.blocks) + 3)
@@ -263,22 +264,28 @@ class TransformerLM(Model):
             self._pipe_mesh = runtime.mesh
         from rocket_tpu.parallel.pipeline import pipeline_blocks
 
-        block = self.blocks[0]
-        has_data = "data" in self._pipe_mesh.shape
+        # One STABLE block_apply per mode — it keys the compiled-pipeline
+        # cache, so a fresh closure per call would recompile every step.
+        block_apply = self._pipe_block_apply.get(mode)
+        if block_apply is None:
+            block = self.blocks[0]
+            has_data = "data" in self._pipe_mesh.shape
 
-        def block_apply(params_i, idx, mb, h):
-            r = rng
-            if r is not None:
-                # Distinct dropout masks per microbatch AND per data shard —
-                # one shared key would correlate every microbatch's mask.
-                r = jax.random.fold_in(r, mb)
-                if has_data:
-                    r = jax.random.fold_in(r, jax.lax.axis_index("data"))
-            y, _ = block.apply(
-                {"params": params_i, "state": {}}, h,
-                mode=mode, rng=r, layer_idx=idx,
-            )
-            return y
+            def block_apply(params_i, idx, mb, h, r):
+                if r is not None:
+                    # Distinct dropout masks per microbatch AND per data
+                    # shard — one shared key would correlate every
+                    # microbatch's mask.
+                    r = jax.random.fold_in(r, mb)
+                    if has_data:
+                        r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+                y, _ = block.apply(
+                    {"params": params_i, "state": {}}, h,
+                    mode=mode, rng=r, layer_idx=idx,
+                )
+                return y
+
+            self._pipe_block_apply[mode] = block_apply
 
         return pipeline_blocks(
             block_apply,
@@ -289,6 +296,7 @@ class TransformerLM(Model):
             data_axis="data",
             num_microbatches=c.pipeline_microbatches,
             remat=c.scan_remat,
+            rng=rng,
         )
 
     def apply(self, variables, batch, *, mode="train", rng=None):

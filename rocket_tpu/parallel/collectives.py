@@ -1,0 +1,21 @@
+"""Small shared shims over jax collective APIs that have moved between
+versions."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["pvary_compat"]
+
+
+def pvary_compat(x, axes):
+    """Mark ``x`` as device-varying over ``axes`` (vma typing for scan
+    carries inside shard_map). jax renamed pvary -> pcast(..., to='varying');
+    older versions only have pvary."""
+    if hasattr(jax.lax, "pcast"):
+        for axis in axes:
+            x = jax.lax.pcast(x, axis, to="varying")
+        return x
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax
+        return jax.lax.pvary(x, tuple(axes))
+    return x  # pragma: no cover — very old jax has no vma typing

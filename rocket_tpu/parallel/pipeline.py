@@ -12,7 +12,7 @@ with the inter-stage transfers on ICI.
 Differentiation is automatic: the tick loop is a ``lax.scan`` and
 ``ppermute`` is differentiable, so ``jax.grad`` of a loss through
 :func:`pipeline_blocks` yields the reverse pipeline schedule. Each stage
-body is rematerialized (``jax.checkpoint``) — the standard memory/compute
+body may be rematerialized (``remat=True``) — the standard memory/compute
 trade at pipeline scale.
 
 Bubble fraction is ``(P-1)/(M+P-1)``; pick ``num_microbatches >= P``
@@ -27,12 +27,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from rocket_tpu.parallel.collectives import pvary_compat
+
 try:  # jax >= 0.8 moved shard_map out of experimental
     from jax import shard_map
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 __all__ = ["pipeline_blocks"]
+
+#: Compiled pipelines keyed by (block_apply, mesh, schedule knobs, treedefs)
+#: — a fresh jit closure per call would retrace the whole M+P-1-tick scan on
+#: every eager invocation.
+_CACHE: dict = {}
 
 
 def pipeline_blocks(
@@ -45,14 +52,16 @@ def pipeline_blocks(
     data_axis: Optional[str] = "data",
     num_microbatches: Optional[int] = None,
     remat: bool = True,
+    rng: Optional[jax.Array] = None,
 ):
     """Run ``x`` (B, T, D) through L stacked layers pipelined over
     ``pipe_axis``.
 
-    ``block_apply(layer_params, global_layer_idx, microbatch_idx, h) -> h``
-    is one layer — fold any dropout rng by BOTH indices (plus the data-shard
-    ``axis_index``), or every microbatch reuses one mask.
-    ``stacked_params`` is the (L, ...) pytree with L sharded over
+    ``block_apply(layer_params, global_layer_idx, microbatch_idx, h, rng)
+    -> h`` is one layer — fold any dropout rng by BOTH indices (plus the
+    data-shard ``axis_index``), or every microbatch reuses one mask. Pass a
+    STABLE callable (not a per-call lambda): it keys the compiled-pipeline
+    cache. ``stacked_params`` is the (L, ...) pytree with L sharded over
     ``pipe_axis`` (and L divisible by the axis size). The batch dim may be
     sharded over ``data_axis``; activations are replicated over the pipe
     axis outside the shard_map.
@@ -64,21 +73,55 @@ def pipeline_blocks(
             f"pipeline: {num_layers} layers must divide over {n_stages} "
             f"pipeline stages."
         )
-    layers_per_stage = num_layers // n_stages
     m = num_microbatches or 2 * n_stages
     batch = x.shape[0]
     # The batch is split per data-shard, so each shard needs m | B/shards.
-    data_shards = mesh.shape[data_axis] if (data_axis and data_axis in mesh.shape) else 1
+    data_shards = (
+        mesh.shape[data_axis] if (data_axis and data_axis in mesh.shape) else 1
+    )
     if (batch // data_shards) % m:
         raise ValueError(
             f"pipeline: per-shard batch {batch // data_shards} must divide "
             f"into {m} microbatches."
         )
 
-    batch_spec = P(data_axis if data_shards > 1 else None, None, None)
-    param_spec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    key = (
+        block_apply,
+        mesh,
+        pipe_axis,
+        data_axis,
+        m,
+        remat,
+        num_layers,
+        jax.tree.structure(stacked_params),
+        rng is None,
+    )
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _CACHE[key] = _build(
+            block_apply,
+            jax.tree.structure(stacked_params),
+            mesh=mesh,
+            pipe_axis=pipe_axis,
+            data_axis=data_axis if data_shards > 1 else None,
+            m=m,
+            remat=remat,
+            n_stages=n_stages,
+            layers_per_stage=num_layers // n_stages,
+        )
+    return fn(stacked_params, x, rng)
 
-    def stage_fn(local_params, x_local):
+
+def _build(
+    block_apply, params_treedef, *, mesh, pipe_axis, data_axis, m, remat,
+    n_stages, layers_per_stage,
+):
+    batch_spec = P(data_axis, None, None)
+    param_spec = jax.tree_util.tree_unflatten(
+        params_treedef, [P(pipe_axis)] * params_treedef.num_leaves
+    )
+
+    def stage_fn(local_params, x_local, rng):
         s = jax.lax.axis_index(pipe_axis)
         b_local = x_local.shape[0]
         micro = x_local.reshape(m, b_local // m, *x_local.shape[1:])
@@ -88,7 +131,9 @@ def pipeline_blocks(
             def layer(h, xs):
                 params_i, local_i = xs
                 return (
-                    block_apply(params_i, s * layers_per_stage + local_i, mb, h),
+                    block_apply(
+                        params_i, s * layers_per_stage + local_i, mb, h, rng
+                    ),
                     None,
                 )
 
@@ -112,22 +157,16 @@ def pipeline_blocks(
             out_idx = t - (n_stages - 1)
             write = (s == n_stages - 1) & (out_idx >= 0) & (out_idx < m)
             idx = jnp.clip(out_idx, 0, m - 1)
-            outputs = outputs.at[idx].set(
-                jnp.where(write, y, outputs[idx])
-            )
+            outputs = outputs.at[idx].set(jnp.where(write, y, outputs[idx]))
             return (incoming, outputs), None
 
         outputs = jnp.zeros_like(micro)
         incoming = jnp.zeros_like(micro[0])
-        # The carries become pipe-varying after one tick (they depend on the
-        # stage index); mark the zero-initialized constants accordingly so
-        # the scan carry types match (jax vma checking).
-        if hasattr(jax.lax, "pcast"):
-            incoming = jax.lax.pcast(incoming, pipe_axis, to="varying")
-            outputs = jax.lax.pcast(outputs, pipe_axis, to="varying")
-        elif hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax
-            incoming = jax.lax.pvary(incoming, (pipe_axis,))
-            outputs = jax.lax.pvary(outputs, (pipe_axis,))
+        # The carries become pipe-varying after one tick (they depend on
+        # the stage index); mark the zero-initialized constants accordingly
+        # so the scan carry types match (jax vma checking).
+        incoming = pvary_compat(incoming, (pipe_axis,))
+        outputs = pvary_compat(outputs, (pipe_axis,))
         (_, outputs), _ = jax.lax.scan(
             tick, (incoming, outputs), jnp.arange(m + n_stages - 1)
         )
@@ -142,9 +181,9 @@ def pipeline_blocks(
     fn = shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(param_spec, batch_spec),
+        in_specs=(param_spec, batch_spec, P()),
         out_specs=batch_spec,
     )
     # jit wrapper: the remat'ed stage body can't evaluate eagerly inside
     # shard_map; under an outer jit (the normal train step) this inlines.
-    return jax.jit(fn)(stacked_params, x)
+    return jax.jit(fn)

@@ -82,11 +82,10 @@ def ring_attention(
     # The accumulators become device-varying after one loop step; mark the
     # initial constants as varying over the ring axis so the carry types
     # match (jax >= 0.8 vma checking).
+    from rocket_tpu.parallel.collectives import pvary_compat
+
     axes = (axis_name,) + tuple(vary_axes)
-    if hasattr(jax.lax, "pcast"):
-        m, l, o = (jax.lax.pcast(x, axes, to="varying") for x in (m, l, o))
-    elif hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax
-        m, l, o = (jax.lax.pvary(x, axes) for x in (m, l, o))
+    m, l, o = (pvary_compat(x, axes) for x in (m, l, o))
 
     q_offset = rank * t_loc
     perm = [(i, (i + 1) % n) for i in range(n)]
