@@ -1,0 +1,77 @@
+"""Tracker: buffer lifecycle, sync-boundary flush, jsonl backend output,
+image buffer routing."""
+
+import json
+import os
+
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.tracker import Tracker
+
+
+class SpyBackend:
+    def __init__(self):
+        self.scalars = []
+        self.images = []
+        self.closed = False
+
+    def log_scalars(self, scalars, step):
+        self.scalars.append((step, dict(scalars)))
+
+    def log_images(self, images, step):
+        self.images.append((step, dict(images)))
+
+    def close(self):
+        self.closed = True
+
+
+def run_epoch(tracker, waves, mode="train"):
+    """Drive one epoch of `waves` dispatch waves by hand."""
+    attrs = Attributes()
+    attrs.mode = mode
+    tracker.set(attrs)
+    for wave in waves:
+        attrs.sync_gradients = wave.get("sync", True)
+        for key, value in wave.get("scalars", {}).items():
+            attrs.tracker.scalars[key] = value
+        if wave.get("image") is not None:
+            attrs.tracker.images["sample"] = wave["image"]
+        tracker.launch(attrs)
+    tracker.reset(attrs)
+    return attrs
+
+
+def test_tracker_flushes_on_sync_boundary_and_buffers_images():
+    spy = SpyBackend()
+    tracker = Tracker(project="t")
+    tracker._backend = spy  # bypass setup's backend construction
+
+    img = np.zeros((4, 4, 3), np.float32)
+    run_epoch(
+        tracker,
+        [
+            {"scalars": {"loss": 1.0}, "sync": True},
+            # Off-boundary: a DISTINCT key buffered, not flushed this wave.
+            {"scalars": {"aux": 2.0}, "sync": False},
+            {"scalars": {"loss": 3.0}, "image": img, "sync": True},
+        ],
+    )
+    # Exactly the two boundary waves flushed — an every-wave flush or a
+    # dropped off-boundary buffer would both change this.
+    assert len(spy.scalars) == 2, spy.scalars
+    assert spy.scalars[0][1] == {"loss": 1.0}
+    # The off-boundary 'aux' value rides into the next boundary flush.
+    assert spy.scalars[1][1] == {"aux": 2.0, "loss": 3.0}
+    assert len(spy.images) == 1 and spy.images[0][1]["sample"] is not None
+
+
+def test_jsonl_backend_writes_records(tmp_path):
+    from rocket_tpu.core.tracker import JsonlBackend
+
+    backend = JsonlBackend("proj", directory=str(tmp_path))
+    backend.log_scalars({"loss": 0.5}, step=3)
+    backend.close()
+    with open(os.path.join(str(tmp_path), "proj.jsonl")) as f:
+        record = json.loads(f.read().splitlines()[-1])
+    assert record["step"] == 3 and record["loss"] == 0.5
