@@ -82,8 +82,8 @@ def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
     launcher.launch()
     print(f"vocab={tok.vocab_size} steps={total_steps}")
 
-    # Sample a continuation from the trained model (generate() recomputes
-    # the causal prefix inside one compiled fori_loop — no KV cache).
+    # Sample a continuation from the trained model (generate() prefills the
+    # prompt, then decodes through per-layer KV caches in one compiled loop).
     from rocket_tpu.models.transformer import generate
 
     prompt = tok.encode("the ")[None, :]

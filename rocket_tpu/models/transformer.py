@@ -185,6 +185,21 @@ class Block(Layer):
             return x + h, out_state
         return x + h, variables["state"]
 
+    def apply_cached(self, params, x, cache: dict, pos):
+        """Decode step: (B, 1, D) through the block with KV-cached attention
+        (eval semantics — no dropout). Returns (y, new_cache)."""
+        h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
+        h, cache = self.attn.apply_cached(params["attn"], h, cache, pos)
+        x = x + h
+        h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
+        if self.moe is not None:
+            h, _ = self.moe.apply({"params": params["moe"], "state": {}}, h)
+        else:
+            h, _ = self.fc_in.apply({"params": params["mlp"]["fc_in"], "state": {}}, h)
+            h = jax.nn.gelu(h)
+            h, _ = self.fc_out.apply({"params": params["mlp"]["fc_out"], "state": {}}, h)
+        return x + h, cache
+
 
 class TransformerLM(Model):
     """Batch contract: reads ``batch["tokens"]`` (B, T) int32, writes
@@ -235,6 +250,58 @@ class TransformerLM(Model):
 
     def num_params(self, variables: Variables) -> int:
         return sum(int(l.size) for l in jax.tree.leaves(variables["params"]))
+
+    # -- incremental decoding ---------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-layer KV caches for :meth:`decode_step` (list of L dicts, or
+        one stacked (L, ...) dict under scan_layers)."""
+        per_layer = self.blocks[0].attn.init_cache(batch, max_len, dtype)
+        L = self.config.num_layers
+        if self.config.scan_layers:
+            return jax.tree.map(
+                lambda l: jnp.zeros((L,) + l.shape, l.dtype), per_layer
+            )
+        # Arrays are immutable — the same zero cache can seed every layer.
+        return [per_layer] * L
+
+    def decode_step(self, params, tokens, caches, pos):
+        """``tokens`` (B, S) int32 written at positions [pos, pos+S) —
+        S = the whole prompt for the batched prefill, S = 1 per decode step
+        after -> (logits (B, V) of the LAST position, updated caches).
+        Attention reads only the KV caches — O(T_max) per step."""
+        p = params
+        s = tokens.shape[1]
+        x = jnp.take(p["wte"]["table"], tokens, axis=0)
+        x = x + jax.lax.dynamic_slice_in_dim(p["wpe"]["table"], pos, s, axis=0)
+        if self.config.activation_dtype is not None:
+            x = x.astype(self.config.activation_dtype)
+
+        if self.config.scan_layers:
+            block = self.blocks[0]
+
+            def body(h, xs):
+                params_i, cache_i = xs
+                h, cache_i = block.apply_cached(params_i, h, cache_i, pos)
+                return h, cache_i
+
+            x, caches = jax.lax.scan(body, x, (p["blocks_stacked"], caches))
+        else:
+            new_caches = []
+            for i, block in enumerate(self.blocks):
+                x, cache_i = block.apply_cached(
+                    p["blocks"][str(i)], x, caches[i], pos
+                )
+                new_caches.append(cache_i)
+            caches = new_caches
+
+        x = x[:, -1:]  # only the last position's logits are consumed
+        x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
+        if self.head is not None:
+            logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+        else:
+            logits = jnp.einsum("btd,vd->btv", x, p["wte"]["table"].astype(x.dtype))
+        return logits[:, 0], caches
 
     def _apply_pipelined(self, p, x, *, mode, rng):
         """Trunk via GPipe stages over config.pipeline_axis
@@ -403,18 +470,30 @@ def generate(
     key=None,
     temperature: float = 1.0,
     top_k: int = None,
+    use_cache: bool = True,
 ):
-    """Autoregressive sampling from a trained LM.
+    """Autoregressive sampling from a trained LM, as ONE compiled loop.
 
-    Recomputes the full (causal) prefix each step inside one compiled
-    ``fori_loop`` — a single executable for the whole generation, no
-    KV-cache state to manage. O(T^2) per token: right for demos and eval
-    loops, not for a serving stack.
+    ``use_cache=True`` (default) prefills the prompt in one batched pass,
+    then decodes incrementally through per-layer KV caches — O(T_max)
+    attention per token (:meth:`TransformerLM.decode_step`).
+    ``use_cache=False`` recomputes the full causal prefix each step —
+    O(T^2) per token, but exercises the exact training forward (useful for
+    cross-checking). Configs the cache path cannot replay faithfully —
+    ring attention (sequence-sharded K/V) and MoE (routing capacity is
+    computed over the full sequence in training but per step in decode) —
+    fall back to the recompute path automatically.
 
     ``temperature=0`` is greedy argmax (no key needed); otherwise pass a
     PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens.
-    Returns (B, prompt_len + max_new_tokens) int32.
+    Per-step sample keys are derived with ``fold_in(key, position)``, so
+    both paths produce identical samples for the same key. Returns
+    (B, prompt_len + max_new_tokens) int32.
     """
+    if use_cache and (
+        model.config.num_experts > 0 or model.config.attention_impl == "ring"
+    ):
+        use_cache = False  # see docstring — cache path would change semantics
     prompt = jnp.asarray(prompt_tokens, jnp.int32)
     if prompt.ndim == 1:
         prompt = prompt[None, :]
@@ -430,38 +509,67 @@ def generate(
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :start].set(prompt)
     key = jax.random.key(0) if key is None else key
-    run = _generate_fn(model, start, total, float(temperature), top_k)
+    run = _generate_fn(model, start, total, float(temperature), top_k, use_cache)
     return run(variables["params"], buf, key)
 
 
+def _sample_token(logits, key, i, temperature, top_k):
+    logits = logits.astype(jnp.float32)
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature > 0:
+        sub = jax.random.fold_in(key, i)
+        return jax.random.categorical(sub, logits / temperature, axis=-1)
+    return jnp.argmax(logits, axis=-1)
+
+
 @functools.lru_cache(maxsize=32)
-def _generate_fn(model, start, total, temperature, top_k):
+def _generate_fn(model, start, total, temperature, top_k, use_cache):
     """Jitted generation loop, cached by (model, window, sampling knobs) —
     a fresh closure per generate() call would retrace and recompile the
     whole model every invocation."""
 
+    if use_cache:
+
+        @jax.jit
+        def run(params, buf, key):
+            dtype = jnp.dtype(model.config.activation_dtype or jnp.float32)
+            caches = model.init_cache(buf.shape[0], total, dtype)
+            # Batched prefill: one MXU-friendly pass fills every layer's
+            # cache for the whole prompt and yields position start-1 logits.
+            logits, caches = model.decode_step(
+                params, buf[:, :start], caches, 0
+            )
+
+            def body(i, carry):
+                buf, caches, logits = carry
+                nxt = _sample_token(logits, key, i, temperature, top_k)
+                buf = buf.at[:, i].set(nxt.astype(jnp.int32))
+                tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
+                logits, caches = model.decode_step(params, tok, caches, i)
+                return buf, caches, logits
+
+            buf, _, _ = jax.lax.fori_loop(
+                start, total, body, (buf, caches, logits)
+            )
+            return buf
+
+        return run
+
     @jax.jit
     def run(params, buf, key):
-        def body(i, carry):
-            buf, key = carry
+        def body(i, buf):
             out, _ = model.apply(
                 {"params": params, "state": {}}, {model.tokens_key: buf},
                 mode="eval",
             )
             logits = jax.lax.dynamic_index_in_dim(
                 out[model.logits_key], i - 1, axis=1, keepdims=False
-            ).astype(jnp.float32)
-            if top_k is not None:
-                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if temperature > 0:
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            return buf.at[:, i].set(nxt.astype(jnp.int32)), key
+            )
+            nxt = _sample_token(logits, key, i, temperature, top_k)
+            return buf.at[:, i].set(nxt.astype(jnp.int32))
 
-        buf, _ = jax.lax.fori_loop(start, total, body, (buf, key))
-        return buf
+        return jax.lax.fori_loop(start, total, body, buf)
 
     return run

@@ -189,5 +189,43 @@ class MultiHeadAttention(Layer):
         out, _ = self.proj.apply({"params": p["proj"], "state": {}}, out)
         return out, variables["state"]
 
+    # -- incremental decoding ---------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32) -> dict:
+        """Empty KV cache for :meth:`apply_cached` ((B, H, T_max, D) pair)."""
+        shape = (batch, self.num_heads, max_len, self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def apply_cached(self, params, x, cache: dict, pos):
+        """Cached decode: ``x`` is (B, S, D) written at key positions
+        [pos, pos+S) — S = prompt length for the batched prefill, S = 1 per
+        token after. Attends causally over cache[: pos+S] — O(T_max) per
+        step instead of recomputing the O(T^2) prefix. Returns
+        (out, new_cache)."""
+        b, s, _ = x.shape
+        qkv, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+
+        k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))
+
+        scale = 1.0 / math.sqrt(self.head_dim)
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+        ) * scale
+        # Query at position pos+i may see key positions <= pos+i.
+        mask = (
+            jnp.arange(k_cache.shape[-2])[None, :]
+            <= pos + jnp.arange(s)[:, None]
+        )
+        logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_cache.dtype), v_cache)
+
+        out = jnp.moveaxis(out, 1, 2).reshape(b, s, self.features)
+        out, _ = self.proj.apply({"params": params["proj"], "state": {}}, out)
+        return out, {"k": k_cache, "v": v_cache}
+
     def __repr__(self):
         return f"MultiHeadAttention(d={self.features}, h={self.num_heads})"
