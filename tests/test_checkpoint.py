@@ -324,3 +324,44 @@ def test_resume_from_latest(tmp_path):
     tree4.setup(rt.Attributes())
     assert int(np.asarray(module4.state["step"])) == 4
     tree4.destroy(rt.Attributes())
+
+
+def test_async_writer_surfaces_errors_and_backpressures():
+    import threading
+
+    from rocket_tpu.runtime.checkpoint_io import AsyncWriter
+
+    writer = AsyncWriter()
+    order = []
+
+    # Backpressure: submit() blocks until the in-flight write finishes —
+    # the second submit cannot return while "a" is still gated.
+    gate = threading.Event()
+
+    def slow_a():
+        gate.wait(5.0)
+        order.append("a")
+
+    import time
+
+    writer.submit(slow_a)
+    release = threading.Timer(0.2, gate.set)
+    release.start()
+    t0 = time.perf_counter()
+    writer.submit(lambda: order.append("b"))  # must block until "a" ran
+    blocked_for = time.perf_counter() - t0
+    assert blocked_for >= 0.15, blocked_for  # submit #2 waited on the gate
+    assert order[0] == "a"
+    writer.wait()
+    assert order == ["a", "b"]
+
+    def boom():
+        raise OSError("disk full")
+
+    writer.submit(boom)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        writer.wait()
+    # The error is consumed; the writer is reusable afterwards.
+    writer.submit(lambda: order.append("c"))
+    writer.wait()
+    assert order[-1] == "c"
