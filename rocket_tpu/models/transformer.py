@@ -76,6 +76,16 @@ class TransformerConfig:
     #: ENTIRE model to f32 compute (≈2x MXU time). Params stay f32 masters;
     #: layernorm/softmax math stays f32 internally.
     activation_dtype: Optional[str] = None
+    #: Fused head+cross-entropy chunk size (0 = off). In train mode the
+    #: model skips materializing (B, T, V) logits and instead computes the
+    #: next-token NLL directly (``batch["nll"]``), scanning the head
+    #: projection + softmax-CE over T-chunks under ``jax.checkpoint``: the
+    #: backward recomputes each chunk's logits, so the saved residual is x
+    #: (B, T, D) instead of the logits. At GPT-2 shapes the full-logits path
+    #: moves ~2.5 GB/step of HBM (bf16 logits + their f32 upcast) and is the
+    #: largest single allocation in the step. ``next_token_loss`` consumes
+    #: either form. Eval mode always materializes logits (metrics need them).
+    loss_chunk: int = 0
 
     @staticmethod
     def char_lm(vocab_size: int = 128, max_seq_len: int = 256) -> "TransformerConfig":
@@ -90,7 +100,7 @@ class TransformerConfig:
         return TransformerConfig(
             vocab_size=vocab_size, max_seq_len=max_seq_len,
             dim=768, num_layers=12, num_heads=12, dropout=0.1,
-            activation_dtype="bfloat16",
+            activation_dtype="bfloat16", loss_chunk=128,
         )
 
 
@@ -203,7 +213,12 @@ class Block(Layer):
 
 class TransformerLM(Model):
     """Batch contract: reads ``batch["tokens"]`` (B, T) int32, writes
-    ``batch["logits"]`` (B, T, V)."""
+    ``batch["logits"]`` (B, T, V) — EXCEPT in train mode with
+    ``config.loss_chunk > 0`` (the gpt2_124m default), where the fused
+    head+CE path writes the ready scalar ``batch["nll"]`` instead and no
+    logits exist (that is the point: the (B, T, V) materialization is the
+    step's largest allocation). Attach logits consumers (e.g. metrics) to
+    eval loopers, which always get logits."""
 
     def __init__(
         self,
@@ -423,17 +438,38 @@ class TransformerLM(Model):
 
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
         # (pipeline path skips the MoE aux loss — see _apply_pipelined)
-        if self.head is not None:
+        out = dict(batch)
+        fused = (
+            self.config.loss_chunk > 0
+            and mode == "train"
+            and t > 1
+            and t % self.config.loss_chunk == 0
+        )
+        if fused:
+            if self.head is not None:
+                hp = p["head"]
+
+                def proj(xc):
+                    return self.head.apply({"params": hp, "state": {}}, xc)[0]
+            else:
+                table = p["wte"]["table"]
+
+                def proj(xc):
+                    return jnp.einsum("bcd,vd->bcv", xc, table.astype(xc.dtype))
+
+            out["nll"] = _chunked_next_token_nll(
+                x, tokens, self.config.loss_chunk, proj
+            )
+        elif self.head is not None:
             logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+            out[self.logits_key] = logits
         else:
             # Tied head: project back through the embedding table. Logits
             # stay in the compute dtype — at GPT-2 shapes an f32 (B, T, V)
             # materialization costs ~6ms/step in HBM traffic; the objective
             # upcasts to f32 for the softmax math (next_token_loss).
             logits = jnp.einsum("btd,vd->btv", x, p["wte"]["table"].astype(x.dtype))
-
-        out = dict(batch)
-        out[self.logits_key] = logits
+            out[self.logits_key] = logits
         if moe and not self.config.pipeline_axis:
             # Pre-weighted router load-balancing loss; next_token_loss adds
             # it when present.
@@ -441,20 +477,57 @@ class TransformerLM(Model):
         return out, variables["state"]
 
 
+def _chunked_next_token_nll(x, tokens, chunk, proj):
+    """Mean next-token NLL without materializing (B, T, V) logits.
+
+    Scans ``proj`` (the head projection) + softmax-CE over T-chunks under
+    ``jax.checkpoint``: the backward recomputes each chunk's logits, so the
+    residual carried from forward to backward is x (B, T, D) instead of the
+    logits. The softmax math runs in f32 per chunk; grads to the head
+    weights accumulate across the scan. Matches ``next_token_loss`` exactly:
+    mean CE of positions [0, T-1) vs tokens[:, 1:].
+    """
+    b, t, d = x.shape
+    nc = t // chunk
+    # Position i predicts tokens[i+1]; the last position has no target and
+    # is masked out (the wrapped filler value never contributes).
+    targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    mask = (jnp.arange(t) < t - 1).astype(jnp.float32)
+    xs = jnp.swapaxes(x.reshape(b, nc, chunk, d), 0, 1)          # (nc,b,c,d)
+    ys = jnp.swapaxes(targets.reshape(b, nc, chunk), 0, 1)       # (nc,b,c)
+    ms = mask.reshape(nc, chunk)                                 # (nc,c)
+
+    def chunk_nll(x_c, y_c, m_c):
+        logits = proj(x_c).astype(jnp.float32)                   # (b,c,V)
+        lse = jax.nn.logsumexp(logits, axis=-1)                  # (b,c)
+        lab = jnp.take_along_axis(logits, y_c[..., None], axis=-1)[..., 0]
+        return jnp.sum((lse - lab) * m_c)
+
+    def body(acc, args):
+        return acc + jax.checkpoint(chunk_nll)(*args), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys, ms))
+    return total / (b * (t - 1))
+
+
 def next_token_loss(
     logits_key: str = "logits", tokens_key: str = "tokens"
 ):
     """Objective: mean cross-entropy of logits[:, :-1] vs tokens[:, 1:],
     plus the model's (pre-weighted) MoE load-balancing aux loss if the batch
-    carries one."""
+    carries one. When the model ran with ``loss_chunk`` (fused head+CE) the
+    batch carries the ready ``nll`` scalar instead of logits."""
     import optax
 
     def objective(batch):
-        logits = batch[logits_key][:, :-1]
-        targets = batch[tokens_key][:, 1:]
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), targets
-        ).mean()
+        if "nll" in batch:
+            loss = batch["nll"]
+        else:
+            logits = batch[logits_key][:, :-1]
+            targets = batch[tokens_key][:, 1:]
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), targets
+            ).mean()
         aux = batch["moe_aux_loss"] if "moe_aux_loss" in batch else None
         return loss if aux is None else loss + aux
 

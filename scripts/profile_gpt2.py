@@ -129,8 +129,30 @@ def main():
     dt_lf = timeit(loss_fn, x, wte)
     dt_lb = timeit(loss_bwd, x, wte)
     logit_flops = 2 * B * T * D * V
-    print(f"logits+loss fwd: {dt_lf*1e3:.2f} ms ({logit_flops/dt_lf/1e12:.1f} TFLOP/s)  "
+    print(f"logits+loss fwd (FULL, not what gpt2_124m runs): "
+          f"{dt_lf*1e3:.2f} ms ({logit_flops/dt_lf/1e12:.1f} TFLOP/s)  "
           f"fwd+bwd: {dt_lb*1e3:.2f} ms ({3*logit_flops/dt_lb/1e12:.1f} TFLOP/s)")
+
+    # --- chunked head+CE (loss_chunk — the production gpt2_124m path) ------
+    from rocket_tpu.models.transformer import _chunked_next_token_nll
+
+    @jax.jit
+    def chunked_fn(x, wte):
+        return _chunked_next_token_nll(
+            x, targets, 128,
+            lambda xc: jnp.einsum("bcd,vd->bcv", xc, wte.astype(xc.dtype)),
+        )
+
+    @jax.jit
+    def chunked_bwd(x, wte):
+        return jax.grad(chunked_fn, argnums=(0, 1))(x, wte)
+
+    dt_cf = timeit(chunked_fn, x, wte)
+    dt_cb = timeit(chunked_bwd, x, wte)
+    print(f"chunked head+CE fwd: {dt_cf*1e3:.2f} ms "
+          f"({logit_flops/dt_cf/1e12:.1f} TFLOP/s)  "
+          f"fwd+bwd: {dt_cb*1e3:.2f} ms "
+          f"({3*logit_flops/dt_cb/1e12:.1f} TFLOP/s model-flops)")
 
     # --- one MLP matmul pair ----------------------------------------------
     w1 = jax.random.normal(key, (D, 4 * D), jnp.bfloat16)

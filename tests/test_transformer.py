@@ -369,3 +369,48 @@ def test_cached_generation_matches_recompute(scan):
         cached = generate(model, variables, prompt, 10, use_cache=True, **kwargs)
         full = generate(model, variables, prompt, 10, use_cache=False, **kwargs)
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
+
+
+@pytest.mark.parametrize("tied", [True, False])
+def test_fused_loss_chunk_matches_full_logits(tied):
+    """loss_chunk (chunked head+CE, no logits materialization) must be a
+    pure optimization: same loss and same grads as the full-logits path."""
+    cfg = tiny_config()
+    cfg.tied_embeddings = tied
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    objective = next_token_loss()
+
+    def loss_with(chunk, params):
+        cfg.loss_chunk = chunk
+        out, _ = model.apply(
+            {"params": params, "state": variables["state"]}, batch, mode="train"
+        )
+        return objective(out)
+
+    full, g_full = jax.value_and_grad(lambda p: loss_with(0, p))(
+        variables["params"]
+    )
+    fused, g_fused = jax.value_and_grad(lambda p: loss_with(8, p))(
+        variables["params"]
+    )
+    np.testing.assert_allclose(float(fused), float(full), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g_full, g_fused,
+    )
+
+
+def test_fused_loss_chunk_skips_eval_and_ragged():
+    cfg = tiny_config()
+    cfg.loss_chunk = 8
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    out, _ = model.apply(variables, {"tokens": tokens}, mode="eval")
+    assert "logits" in out and "nll" not in out  # eval keeps logits
+    ragged = jnp.zeros((2, 13), jnp.int32)  # 13 % 8 != 0 -> full path
+    out, _ = model.apply(variables, {"tokens": ragged}, mode="train")
+    assert "logits" in out and "nll" not in out
