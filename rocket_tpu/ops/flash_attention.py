@@ -73,14 +73,16 @@ def pick_block(t: int, preferred: int = 512) -> Optional[int]:
     return None
 
 
-def _causal_mask(s):
-    """Lower-triangular mask for an aligned diagonal block.
+def _causal_mask(s, transposed: bool = False):
+    """Causal mask for an aligned diagonal block (broadcasts over the
+    leading head-batch dim).
 
-    ``s`` is (hb, block_q, block_k) — the mask broadcasts over the
-    head-batch dim."""
+    ``s`` is (hb, block_q, block_k): keep q_idx (rows) >= k_idx (cols).
+    With ``transposed`` it is (hb, block_k, block_q): keep rows <= cols."""
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 2)
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 1)
-    return jnp.where(rows >= cols, s, _NEG_INF)
+    keep = rows <= cols if transposed else rows >= cols
+    return jnp.where(keep, s, _NEG_INF)
 
 
 # --------------------------------------------------------------------------
@@ -140,8 +142,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l = l_s[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        # lse kept in the base-2 domain: lse2 = m2 + log2(l).
-        lse_ref[0] = m_s[:] + jnp.log2(safe_l)
+        # lse kept in the base-2 domain: lse2 = m2 + log2(l). Stored
+        # (hb, 1, bq) — q along LANES — so the HBM array is (B, H, 1, T):
+        # a (T, 1) trailing layout would be tile-padded 128x (~48 MB/layer
+        # of padding at GPT-2 shapes), (1, T) only pads sublanes 8x, and
+        # the transposed backward kernel broadcasts it for free.
+        lse_ref[0] = jnp.swapaxes(m_s[:] + jnp.log2(safe_l), 1, 2)
 
 
 def _head_block(h: int) -> int:
@@ -173,11 +179,11 @@ def _fwd(qkv, *, causal, block_q, block_k, interpret):
         in_specs=[qs(0), ks(1), ks(2)],
         out_specs=[
             pl.BlockSpec((1, hb, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, 1, block_q), lambda b, h, iq, ik: (b, h, 0, iq)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t, d), qkv.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((hb, block_q, d), jnp.float32),
@@ -209,33 +215,37 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def tile(masked: bool):
+        # Scores are computed TRANSPOSED — (hb, bk, bq), q along lanes — so
+        # the per-q stats lse/delta, stored (hb, 1, bq), broadcast across
+        # the sublane (k) dim natively; the (bq, bk) orientation would need
+        # the stats in a 128x-tile-padded (T, 1) HBM layout instead.
         q = q_ref[0, 0]  # (hb, bq, d)
         k = k_ref[0, 0]
-        s2 = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
+        s2t = jax.lax.dot_general(
+            k, q, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale2  # (hb, bq, bk)
+        ) * scale2  # (hb, bk, bq)
         if masked:
-            s2 = _causal_mask(s2)
-        p = jnp.exp2(s2 - lse_ref[0])
+            s2t = _causal_mask(s2t, transposed=True)
+        pt = jnp.exp2(s2t - lse_ref[0])  # lse (hb, 1, bq)
         do = do_ref[0]  # (hb, bq, d)
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+            pt.astype(do.dtype), do, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )  # (hb, bk, d)
-        dp = jax.lax.dot_general(
-            do, v_ref[0, 0], (((2,), (2,)), ((0,), (0,))),
+        dpt = jax.lax.dot_general(
+            v_ref[0, 0], do, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # (hb, bq, bk)
-        ds = p * (dp - delta_ref[0]) * scale
-        ds_c = ds.astype(q.dtype)
+        )  # (hb, bk, bq)
+        ds_t = pt * (dpt - delta_ref[0]) * scale
+        ds_c = ds_t.astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
-            ds_c, q, (((1,), (1,)), ((0,), (0,))),
+            ds_c, q, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )  # (hb, bk, d)
         # This kv block's contribution to dq — summed over blocks outside.
         dqp_ref[0, 0] = jax.lax.dot_general(
-            ds_c, k, (((2,), (1,)), ((0,), (0,))),
+            ds_c, k, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ).astype(dqp_ref.dtype)  # (hb, bq, d)
 
@@ -267,11 +277,11 @@ def _bwd(causal, block_q, block_k, interpret, res, dout):
     scale2 = _LOG2E / math.sqrt(d)
     nq, nk = t // block_q, t // block_k
 
-    # delta = rowsum(dout * out), column layout (B, H, T, 1) to match lse.
+    # delta = rowsum(dout * out), (B, H, 1, T) row layout to match lse — a
+    # (T, 1) trailing layout would be tile-padded 128x in HBM.
     delta = jnp.sum(
         out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1,
-        keepdims=True,
-    )  # (B, H, T, 1)
+    )[:, :, None, :]  # (B, H, 1, T)
 
     hb = _head_block(h)
 
@@ -291,8 +301,8 @@ def _bwd(causal, block_q, block_k, interpret, res, dout):
         in_specs=[
             qs(0), ks(1), ks(2),
             pl.BlockSpec((1, hb, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, hb, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, hb, 1, block_q), lambda b, h, ik, iq: (b, h, 0, iq)),
+            pl.BlockSpec((1, hb, 1, block_q), lambda b, h, ik, iq: (b, h, 0, iq)),
         ],
         out_specs=[
             pl.BlockSpec(
