@@ -9,6 +9,9 @@ goes to stderr):
 * ``charlm``     — TinyShakespeare char-transformer, B=128, T=256
                    (configs[2]): tok/sec/chip + MFU.
 * ``resnet18``   — CIFAR-10 ResNet-18, B=256 (configs[1]): samples/sec/chip.
+* ``resnet50``   — ImageNet-shape ResNet-50, B=64 (configs[3], single chip;
+                   the DDP scaling half needs real multi-chip hardware):
+                   samples/sec/chip + MFU.
 * ``mlp``        — MNIST MLP, B=1024 (configs[0], round-1 continuity):
                    samples/sec/chip vs the torch-CPU measurement.
 
@@ -178,13 +181,16 @@ def bench_mlp(warmup=10, steps=60, batch=1024):
     }
 
 
-def bench_resnet18(warmup=5, steps=30, batch=256):
+def _bench_cnn(model, shape, batch, warmup, steps, metric, gmacs_fwd,
+               num_classes):
+    """Shared CNN bench body. ``gmacs_fwd``: forward G-MACs per sample
+    (1 MAC = 2 FLOPs, matching peak_flops' FMA hardware peak); training
+    counts ~3x forward."""
     import jax.numpy as jnp
 
     n_dev = len(jax.devices())
     runtime = rt.Runtime(seed=0)
-    data = _class_dataset((32, 32, 3), batch, warmup, steps)
-    model = resnet18(num_classes=10, stem="cifar")
+    data = _class_dataset(shape, batch, warmup, steps, num_classes=num_classes)
     module = rt.Module(
         model,
         capsules=[
@@ -201,18 +207,37 @@ def bench_resnet18(warmup=5, steps=30, batch=256):
     per_chip = batch / timer.best_step_time() / n_dev
     mean_per_chip = batch / timer.mean_step_time() / n_dev
     out = {
-        "metric": "cifar_resnet18_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(per_chip, 1),
         "unit": "samples/sec/chip",
         "mean_value": round(mean_per_chip, 1),
     }
     peak = peak_flops()
     if peak is not None:
-        # CIFAR-stem ResNet-18 @32x32: ~0.557 G MACs = ~1.11 GFLOP forward
-        # per sample; training ~3x forward.
-        out["mfu"] = round(per_chip * 3 * 2 * 0.557e9 / peak, 4)
-        out["mean_mfu"] = round(mean_per_chip * 3 * 2 * 0.557e9 / peak, 4)
+        flops_per_sample = 3 * 2 * gmacs_fwd * 1e9
+        out["mfu"] = round(per_chip * flops_per_sample / peak, 4)
+        out["mean_mfu"] = round(mean_per_chip * flops_per_sample / peak, 4)
     return out
+
+
+def bench_resnet18(warmup=5, steps=30, batch=256):
+    # CIFAR-stem ResNet-18 @32x32: ~0.557 G-MACs forward per sample.
+    return _bench_cnn(
+        resnet18(num_classes=10, stem="cifar"), (32, 32, 3), batch,
+        warmup, steps, "cifar_resnet18_samples_per_sec_per_chip",
+        gmacs_fwd=0.557, num_classes=10,
+    )
+
+
+def bench_resnet50(warmup=4, steps=12, batch=64):
+    from rocket_tpu.models.resnet import resnet50
+
+    # ResNet-50 @224x224: ~4.1 G-MACs forward per sample.
+    return _bench_cnn(
+        resnet50(num_classes=1000), (224, 224, 3), batch,
+        warmup, steps, "imagenet_resnet50_samples_per_sec_per_chip",
+        gmacs_fwd=4.1, num_classes=1000,
+    )
 
 
 def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):
@@ -279,6 +304,7 @@ BENCHES = {
     "gpt2": bench_gpt2,
     "charlm": bench_charlm,
     "resnet18": bench_resnet18,
+    "resnet50": bench_resnet50,
     "mlp": bench_mlp,
 }
 
@@ -321,6 +347,7 @@ METRIC_NAMES = {
     "gpt2": "gpt2_124m_tok_per_sec_per_chip",
     "charlm": "charlm_tok_per_sec_per_chip",
     "resnet18": "cifar_resnet18_samples_per_sec_per_chip",
+    "resnet50": "imagenet_resnet50_samples_per_sec_per_chip",
     "mlp": "mnist_mlp_samples_per_sec_per_chip",
 }
 

@@ -194,7 +194,8 @@ class Module(Dispatcher):
         opt = optimizers[0].opt if optimizers else None
         schedule = schedulers[0].schedule if schedulers else None
         base_lr = optimizers[0].learning_rate if optimizers else None
-        return objective, opt, schedule, base_lr
+        clip_norm = optimizers[0].clip_norm if optimizers else None
+        return objective, opt, schedule, base_lr, clip_norm
 
     # -- events ------------------------------------------------------------
 
@@ -215,12 +216,14 @@ class Module(Dispatcher):
             runtime.models.add(self._model, prepared)
         self._prepared = prepared
 
-        objective, opt, schedule, base_lr = self._find_contrib()
+        objective, opt, schedule, base_lr, clip_norm = self._find_contrib()
         if opt is not None:
             if objective is None:
                 raise RuntimeError("Module: an Optimizer child requires a Loss child.")
             lr = schedule if schedule is not None else (base_lr if base_lr is not None else 1e-3)
             tx = optim_lib.resolve(opt, lr)
+            if clip_norm is not None:
+                tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
             if "opt_state" not in prepared.state:
                 prepared.state["opt_state"] = tx.init(prepared.state["params"])
                 if runtime.gradient_accumulation_steps > 1:

@@ -31,18 +31,28 @@ class Optimizer(Capsule):
         self,
         opt: Union[optax.GradientTransformation, "callable"],
         learning_rate: Optional[float] = None,
+        clip_norm: Optional[float] = None,
         statefull: bool = False,
         priority: int = 1000,
         runtime=None,
     ) -> None:
+        """``clip_norm``: clip gradients to this global L2 norm before the
+        update (the torch-world ``accelerator.clip_grad_norm_`` step, which
+        the reference leaves to user code); compiled into the jitted step
+        ahead of the update rule."""
         super().__init__(statefull=statefull, priority=priority, runtime=runtime)
         self._opt = opt
         self._learning_rate = learning_rate
+        self._clip_norm = clip_norm
         self._iter_idx = 0
 
     @property
     def opt(self):
         return self._opt
+
+    @property
+    def clip_norm(self) -> Optional[float]:
+        return self._clip_norm
 
     @property
     def learning_rate(self) -> Optional[float]:

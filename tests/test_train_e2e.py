@@ -246,3 +246,57 @@ def test_batch_is_sharded_over_mesh(runtime8):
     assert sharding.num_devices == 8
     shard_shape = sharding.shard_shape((64, 8))
     assert shard_shape == (8, 8)
+
+
+def test_gradient_clipping_bounds_update(tmp_path):
+    """Optimizer(clip_norm=c) with plain SGD(lr) bounds every update's
+    global norm by lr * c."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    data = make_dataset(n=64)
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(cross_entropy),
+            # lr huge so an unclipped first step would move params by >> 1.
+            rt.Optimizer(optim.sgd(), learning_rate=1.0, clip_norm=1e-3),
+        ],
+    )
+    snapshots = []
+
+    class ParamSpy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)  # after the Module's step
+
+        def launch(self, attrs=None):
+            if attrs.mode == "train":
+                # Host copies: the step donates its state buffers, so device
+                # references would be deleted by the next step.
+                snapshots.append(
+                    jax.tree.map(lambda x: np.asarray(x), module.state["params"])
+                )
+
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [rt.Dataset(data, batch_size=64), module, ParamSpy()],
+                tag="train", progress=False,
+            )
+        ],
+        num_epochs=2,
+        runtime=runtime,
+    )
+    launcher.launch()
+    assert len(snapshots) == 2
+    delta = jax.tree.map(lambda a, b: a - b, snapshots[1], snapshots[0])
+    norm = float(
+        jnp.sqrt(
+            sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in jax.tree.leaves(delta))
+        )
+    )
+    assert 0.0 < norm <= 1e-3 * 1.01, norm
