@@ -371,12 +371,14 @@ def test_cached_generation_matches_recompute(scan):
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
 
 
-@pytest.mark.parametrize("tied", [True, False])
-def test_fused_loss_chunk_matches_full_logits(tied):
+@pytest.mark.parametrize("tied,scan", [(True, False), (False, False), (True, True), (False, True)])
+def test_fused_loss_chunk_matches_full_logits(tied, scan):
     """loss_chunk (chunked head+CE, no logits materialization) must be a
-    pure optimization: same loss and same grads as the full-logits path."""
+    pure optimization: same loss and same grads as the full-logits path —
+    including on the scan-over-layers trunk."""
     cfg = tiny_config()
     cfg.tied_embeddings = tied
+    cfg.scan_layers = scan
     model = TransformerLM(cfg)
     variables = model.init(jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
