@@ -547,6 +547,7 @@ def generate(
     key=None,
     temperature: float = 1.0,
     top_k: int = None,
+    top_p: float = None,
     use_cache: bool = True,
 ):
     """Autoregressive sampling from a trained LM, as ONE compiled loop.
@@ -562,7 +563,9 @@ def generate(
     fall back to the recompute path automatically.
 
     ``temperature=0`` is greedy argmax (no key needed); otherwise pass a
-    PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens.
+    PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens;
+    ``top_p`` to the smallest set whose (temperature-scaled) probability
+    mass reaches p (nucleus sampling) — both filters compose.
     Per-step sample keys are derived with ``fold_in(key, position)``, so
     both paths produce identical samples for the same key. Returns
     (B, prompt_len + max_new_tokens) int32.
@@ -583,26 +586,43 @@ def generate(
         )
     if temperature > 0 and key is None:
         raise ValueError("generate: sampling (temperature > 0) needs a PRNG key")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p <= 0 would mask EVERY token to -inf and categorical() would
+        # silently emit token 0 forever.
+        raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :start].set(prompt)
     key = jax.random.key(0) if key is None else key
-    run = _generate_fn(model, start, total, float(temperature), top_k, use_cache)
+    run = _generate_fn(
+        model, start, total, float(temperature), top_k,
+        None if top_p is None else float(top_p), use_cache,
+    )
     return run(variables["params"], buf, key)
 
 
-def _sample_token(logits, key, i, temperature, top_k):
+def _sample_token(logits, key, i, temperature, top_k, top_p):
     logits = logits.astype(jnp.float32)
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if temperature > 0:
-        sub = jax.random.fold_in(key, i)
-        return jax.random.categorical(sub, logits / temperature, axis=-1)
-    return jnp.argmax(logits, axis=-1)
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)  # filters don't move the argmax
+    logits = logits / temperature
+    if top_p is not None and top_p < 1.0:
+        # Nucleus: keep the smallest descending-prob prefix whose mass
+        # reaches top_p (the first token always survives: cum - p < top_p).
+        sl = jnp.sort(logits, axis=-1)[..., ::-1]
+        ps = jax.nn.softmax(sl, axis=-1)
+        cum = jnp.cumsum(ps, axis=-1)
+        keep = cum - ps < top_p
+        cutoff = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    sub = jax.random.fold_in(key, i)
+    return jax.random.categorical(sub, logits, axis=-1)
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_fn(model, start, total, temperature, top_k, use_cache):
+def _generate_fn(model, start, total, temperature, top_k, top_p, use_cache):
     """Jitted generation loop, cached by (model, window, sampling knobs) —
     a fresh closure per generate() call would retrace and recompile the
     whole model every invocation."""
@@ -621,7 +641,7 @@ def _generate_fn(model, start, total, temperature, top_k, use_cache):
 
             def body(i, carry):
                 buf, caches, logits = carry
-                nxt = _sample_token(logits, key, i, temperature, top_k)
+                nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
                 buf = buf.at[:, i].set(nxt.astype(jnp.int32))
                 tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
                 logits, caches = model.decode_step(params, tok, caches, i)
@@ -644,7 +664,7 @@ def _generate_fn(model, start, total, temperature, top_k, use_cache):
             logits = jax.lax.dynamic_index_in_dim(
                 out[model.logits_key], i - 1, axis=1, keepdims=False
             )
-            nxt = _sample_token(logits, key, i, temperature, top_k)
+            nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
             return buf.at[:, i].set(nxt.astype(jnp.int32))
 
         return jax.lax.fori_loop(start, total, body, buf)

@@ -365,6 +365,7 @@ def test_cached_generation_matches_recompute(scan):
     for kwargs in (
         dict(temperature=0),
         dict(key=jax.random.key(5), temperature=0.9, top_k=10),
+        dict(key=jax.random.key(6), temperature=0.9, top_p=0.8),
     ):
         cached = generate(model, variables, prompt, 10, use_cache=True, **kwargs)
         full = generate(model, variables, prompt, 10, use_cache=False, **kwargs)
@@ -416,3 +417,33 @@ def test_fused_loss_chunk_skips_eval_and_ragged():
     ragged = jnp.zeros((2, 13), jnp.int32)  # 13 % 8 != 0 -> full path
     out, _ = model.apply(variables, {"tokens": ragged}, mode="train")
     assert "logits" in out and "nll" not in out
+
+
+def test_generate_top_p_restricts_to_nucleus():
+    """With a peaked distribution and small top_p, sampling must collapse
+    to the argmax token; top_p=1.0 must match unfiltered sampling."""
+    from rocket_tpu.models.transformer import generate
+
+    cfg = tiny_config()
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+
+    greedy = generate(model, variables, prompt, 8, temperature=0.0)
+    # Tiny temperature -> distribution is sharply peaked; top_p=0.1 keeps
+    # only the top token, so the sample must equal greedy decoding.
+    nucleus = generate(
+        model, variables, prompt, 8,
+        key=jax.random.key(1), temperature=0.05, top_p=0.1,
+    )
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+    full = generate(
+        model, variables, prompt, 8, key=jax.random.key(2), temperature=1.0,
+    )
+    loose = generate(
+        model, variables, prompt, 8, key=jax.random.key(2), temperature=1.0,
+        top_p=1.0,
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(loose))
+    assert nucleus.shape == (2, 12)
