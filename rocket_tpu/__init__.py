@@ -27,7 +27,7 @@ from rocket_tpu.core import (
 )
 from rocket_tpu.runtime.context import Runtime
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "Attributes",
