@@ -222,6 +222,7 @@ class Module(Dispatcher):
                 raise RuntimeError("Module: an Optimizer child requires a Loss child.")
             lr = schedule if schedule is not None else (base_lr if base_lr is not None else 1e-3)
             tx = optim_lib.resolve(opt, lr)
+            report_grad_norm = clip_norm is not None
             if clip_norm is not None:
                 tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
             if "opt_state" not in prepared.state:
@@ -234,7 +235,7 @@ class Module(Dispatcher):
                     # so the Loss capsule never issues eager device ops.
                     prepared.state["loss_acc"] = jnp.zeros((), jnp.float32)
             self._lr_fn = lr if callable(lr) else (lambda step: jnp.asarray(lr))
-            self._build_train_step(objective, tx)
+            self._build_train_step(objective, tx, report_grad_norm=report_grad_norm)
         elif objective is not None:
             raise RuntimeError("Module: a Loss child requires an Optimizer child.")
 
@@ -350,7 +351,7 @@ class Module(Dispatcher):
 
         return forward
 
-    def _build_train_step(self, objective, tx) -> None:
+    def _build_train_step(self, objective, tx, report_grad_norm=False) -> None:
         runtime = self._runtime
         accum = runtime.gradient_accumulation_steps
         forward = self._forward()
@@ -396,14 +397,22 @@ class Module(Dispatcher):
                 def apply_update(operand):
                     acc, params, opt_state = operand
                     mean_grads = jax.tree.map(lambda g: g / accum, acc)
+                    # The pre-clip norm of what the clip actually acts on
+                    # (the window's mean grads) — NOT the microbatch grads.
+                    gn = (
+                        optax.global_norm(mean_grads)
+                        if report_grad_norm
+                        else jnp.zeros((), jnp.float32)
+                    )
                     updates, opt_state = tx.update(mean_grads, opt_state, params)
                     params = optax.apply_updates(params, updates)
-                    return _tree_zeros_like(acc), params, opt_state
+                    return _tree_zeros_like(acc), params, opt_state, gn
 
                 def hold(operand):
-                    return operand
+                    acc, params, opt_state = operand
+                    return acc, params, opt_state, jnp.zeros((), jnp.float32)
 
-                acc, params, opt_state = jax.lax.cond(
+                acc, params, opt_state, accum_grad_norm = jax.lax.cond(
                     is_boundary,
                     apply_update,
                     hold,
@@ -427,6 +436,14 @@ class Module(Dispatcher):
                 "loss_window": loss_window,
                 "lr": jnp.asarray(lr_fn(opt_step), jnp.float32),
             }
+            if report_grad_norm:
+                # Pre-clip global norm of the gradients the clip acts on:
+                # the raw step grads (accum=1, XLA shares the reduction with
+                # the clip itself) or the accumulation window's mean grads
+                # (boundary only; zero off-boundary, where nothing clips).
+                metrics["grad_norm"] = (
+                    optax.global_norm(grads) if accum == 1 else accum_grad_norm
+                )
             if return_out:
                 metrics["outputs"] = out
             return new_state, metrics

@@ -76,6 +76,13 @@ class Optimizer(Capsule):
                 attrs.tracker.scalars["lr"] = attrs.step_metrics.lr
             if attrs.looper is not None:
                 attrs.looper.state.lr = attrs.step_metrics.lr
+        if attrs.step_metrics is not None and attrs.step_metrics.grad_norm is not None:
+            # Pre-clip global grad norm (present when clip_norm is set) —
+            # a device scalar, same no-sync contract as lr/loss.
+            if attrs.tracker is not None:
+                attrs.tracker.scalars["grad_norm"] = attrs.step_metrics.grad_norm
+            if attrs.looper is not None:
+                attrs.looper.state.grad_norm = attrs.step_metrics.grad_norm
 
     # -- checkpoint state (optimizer.py:81-85). Wired, but OFF by default:
     # saved only when constructed with statefull=True — the optimizer's

@@ -248,15 +248,20 @@ def test_batch_is_sharded_over_mesh(runtime8):
     assert shard_shape == (8, 8)
 
 
-def test_gradient_clipping_bounds_update(tmp_path):
+@pytest.mark.parametrize("accum", [1, 2])
+def test_gradient_clipping_bounds_update(tmp_path, accum):
     """Optimizer(clip_norm=c) with plain SGD(lr) bounds every update's
-    global norm by lr * c."""
+    global norm by lr * c; the pre-clip grad_norm metric reports what the
+    clip acts on (mean grads at the boundary under accumulation)."""
     import jax
     import jax.numpy as jnp
 
     from rocket_tpu.runtime.context import Runtime
 
-    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        gradient_accumulation_steps=accum,
+    )
     model = MLP(in_features=8, num_classes=4, hidden=(16,))
     data = make_dataset(n=64)
     module = rt.Module(
@@ -268,6 +273,7 @@ def test_gradient_clipping_bounds_update(tmp_path):
         ],
     )
     snapshots = []
+    grad_norms = []
 
     class ParamSpy(rt.Capsule):
         def __init__(self):
@@ -280,6 +286,7 @@ def test_gradient_clipping_bounds_update(tmp_path):
                 snapshots.append(
                     jax.tree.map(lambda x: np.asarray(x), module.state["params"])
                 )
+                grad_norms.append(float(np.asarray(attrs.step_metrics.grad_norm)))
 
     launcher = rt.Launcher(
         [
@@ -299,4 +306,15 @@ def test_gradient_clipping_bounds_update(tmp_path):
             sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in jax.tree.leaves(delta))
         )
     )
-    assert 0.0 < norm <= 1e-3 * 1.01, norm
+    if accum == 1:
+        assert 0.0 < norm <= 1e-3 * 1.01, norm
+    else:
+        # Two epochs x one batch = one window: snapshot[0] is off-boundary
+        # (no update yet), snapshot[1] is right after the clipped update.
+        assert 0.0 < norm <= 1e-3 * 1.01, norm
+    # clip_norm also surfaces the PRE-clip grad norm of what the clip acts
+    # on; off-boundary micro-steps report 0.
+    assert len(grad_norms) == 2, grad_norms
+    assert max(grad_norms) > 1e-3, grad_norms
+    if accum == 2:
+        assert grad_norms[0] == 0.0, grad_norms
