@@ -6,6 +6,8 @@ goes to stderr):
 * ``gpt2``       — GPT-2 124M, B=8, T=1024, bf16, flash attention, AdamW
                    (BASELINE.json configs[4], single chip). THE headline
                    metric: tok/sec/chip + MFU.
+* ``gpt2_350m``  — GPT-2 medium (d=1024, ~354M params): the wider matmuls
+                   fill the MXU better — the framework's best-MFU config.
 * ``charlm``     — TinyShakespeare char-transformer, B=128, T=256
                    (configs[2]): tok/sec/chip + MFU.
 * ``resnet18``   — CIFAR-10 ResNet-18, B=256 (configs[1]): samples/sec/chip.
@@ -300,8 +302,15 @@ def bench_gpt2(warmup=5, steps=30):
     return out
 
 
+def bench_gpt2_350m(warmup=4, steps=15):
+    config = TransformerConfig.gpt2_350m()
+    config.dropout = 0.0
+    return _bench_lm(config, batch=8, warmup=warmup, steps=steps, name="gpt2_350m")
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
+    "gpt2_350m": bench_gpt2_350m,
     "charlm": bench_charlm,
     "resnet18": bench_resnet18,
     "resnet50": bench_resnet50,
@@ -345,6 +354,7 @@ def _require_live_backend(headline_metric: str, timeout_s: float = 120.0) -> Non
 #: Headline metric name per config (error reporting when the backend is down).
 METRIC_NAMES = {
     "gpt2": "gpt2_124m_tok_per_sec_per_chip",
+    "gpt2_350m": "gpt2_350m_tok_per_sec_per_chip",
     "charlm": "charlm_tok_per_sec_per_chip",
     "resnet18": "cifar_resnet18_samples_per_sec_per_chip",
     "resnet50": "imagenet_resnet50_samples_per_sec_per_chip",

@@ -55,6 +55,7 @@ class Checkpointer(Capsule):
         resume_from: Optional[str] = None,
         resume_capsules: bool = True,
         keep_last: Optional[int] = None,
+        overwrite: bool = True,
         statefull: bool = True,
         priority: int = PRIORITY_CHECKPOINT,
         runtime=None,
@@ -65,6 +66,7 @@ class Checkpointer(Capsule):
         self._resume_from = resume_from
         self._resume_capsules = resume_capsules
         self._keep_last = keep_last
+        self._overwrite = overwrite
         self._iter_idx = 0
         self._saved_steps: list[int] = []
         self._writer = checkpoint_io.AsyncWriter()
@@ -173,6 +175,12 @@ class Checkpointer(Capsule):
         runtime = self._runtime
         step = self._iter_idx if step is None else step
         path = os.path.join(self._output_dir, str(step))
+        if not self._overwrite and os.path.exists(path):
+            # Reference parity (``checkpoint.py:66-69``): refuse to clobber
+            # an existing step directory when overwrite=False.
+            raise RuntimeError(
+                f"Checkpointer: overwrite is set to False. {path}"
+            )
 
         # Backpressure: at most one write in flight, and the previous step's
         # files are complete before this one starts (keep_last can prune

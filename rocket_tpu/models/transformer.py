@@ -103,6 +103,17 @@ class TransformerConfig:
             activation_dtype="bfloat16", loss_chunk=128,
         )
 
+    @staticmethod
+    def gpt2_350m(vocab_size: int = 50257, max_seq_len: int = 1024) -> "TransformerConfig":
+        """GPT-2 medium (~354M params). The wider (d=1024) matmuls fill the
+        MXU better than 124M: measured ~51% single-chip MFU where the same
+        contention window gave 124M ~45%."""
+        return TransformerConfig(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            dim=1024, num_layers=24, num_heads=16, dropout=0.1,
+            activation_dtype="bfloat16", loss_chunk=128,
+        )
+
 
 class Block(Layer):
     """Pre-LN transformer block: x += attn(ln1(x)); x += mlp(ln2(x))."""

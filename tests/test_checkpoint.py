@@ -365,3 +365,26 @@ def test_async_writer_surfaces_errors_and_backpressures():
     writer.submit(lambda: order.append("c"))
     writer.wait()
     assert order[-1] == "c"
+
+
+def test_overwrite_false_refuses_existing_step(tmp_path):
+    """Reference parity (checkpoint.py:66-69): overwrite=False raises
+    rather than clobbering an existing step directory."""
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    ckpt = rt.Checkpointer(
+        output_dir=str(tmp_path / "ck"), save_every=1, overwrite=False
+    )
+    launcher = rt.Launcher(
+        [rt.Looper([rt.Dataset(make_dataset(n=32), batch_size=32), module, ckpt],
+                   tag="train")],
+        num_epochs=1, statefull=True, runtime=runtime,
+    )
+    launcher.launch()  # writes step 1
+    os.makedirs(str(tmp_path / "ck" / "2"))  # simulate a pre-existing target
+    with pytest.raises(RuntimeError, match="overwrite"):
+        ckpt.save(step=2)
