@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Callable, Union
 
+import jax
 import optax
 
 __all__ = [
@@ -59,14 +60,31 @@ def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Factory:
 
 
 def adamw(
-    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.01
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+    mask_1d: bool = True,
 ) -> Factory:
+    """AdamW with the standard GPT-2/nanoGPT decay convention: with
+    ``mask_1d`` (default) weight decay applies only to params with ndim >= 2
+    (matmul kernels, embeddings) — biases and layernorm scales are exempt.
+    Pass ``mask_1d=False`` for torch's decay-everything behavior."""
+
     def make(learning_rate):
+        mask = _decay_mask if mask_1d and weight_decay else None
         return optax.adamw(
-            learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay
+            learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+            mask=mask,
         )
 
     return make
+
+
+def _decay_mask(params):
+    """True for params weight decay applies to: ndim >= 2 (kernels,
+    embeddings); biases and layernorm scales are exempt."""
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
 
 
 # -- schedules (step -> lr), torch-scheduler analogues ----------------------

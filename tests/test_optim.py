@@ -1,0 +1,37 @@
+"""Optimizer factories: decay masking, schedule injection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu import optim
+
+
+def test_adamw_masks_decay_off_1d_params():
+    """With zero grads, decay is the only force: 2-D kernels shrink, 1-D
+    biases/scales stay put (GPT-2 convention); mask_1d=False decays both."""
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    def step(factory):
+        tx = optim.resolve(factory, 0.1)
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        import optax
+
+        return optax.apply_updates(params, updates)
+
+    masked = step(optim.adamw(weight_decay=0.1))
+    assert float(jnp.max(jnp.abs(masked["b"] - 1.0))) == 0.0  # exempt
+    assert float(masked["w"][0, 0]) < 1.0  # decayed
+
+    decay_all = step(optim.adamw(weight_decay=0.1, mask_1d=False))
+    assert float(decay_all["b"][0]) < 1.0
+
+
+def test_adamw_zero_decay_needs_no_mask():
+    params = {"b": jnp.ones((4,))}
+    tx = optim.resolve(optim.adamw(weight_decay=0.0), 0.1)
+    state = tx.init(params)
+    updates, _ = tx.update(jax.tree.map(jnp.zeros_like, params), state, params)
+    np.testing.assert_array_equal(np.asarray(updates["b"]), 0.0)
