@@ -36,6 +36,10 @@ class TransformerConfig:
     dim: int
     num_layers: int
     num_heads: int
+    #: Grouped-query attention: K/V heads (None = num_heads = standard MHA;
+    #: 1 = MQA). Shrinks the KV cache and K/V projection by
+    #: num_heads/num_kv_heads; runs on the grouped XLA attention path.
+    num_kv_heads: Optional[int] = None
     mlp_ratio: int = 4
     dropout: float = 0.0
     tied_embeddings: bool = True
@@ -122,8 +126,8 @@ class Block(Layer):
         c = config
         self.ln1 = LayerNorm(c.dim)
         self.attn = MultiHeadAttention(
-            c.dim, c.num_heads, causal=True, dropout=c.dropout,
-            impl=c.attention_impl, seq_axis=c.seq_axis,
+            c.dim, c.num_heads, num_kv_heads=c.num_kv_heads, causal=True,
+            dropout=c.dropout, impl=c.attention_impl, seq_axis=c.seq_axis,
         )
         self.ln2 = LayerNorm(c.dim)
         if c.num_experts > 0:

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from rocket_tpu.nn.layers import Dense
 from rocket_tpu.nn.module import Layer
 
-__all__ = ["MultiHeadAttention", "dot_product_attention", "resolve_impl"]
+__all__ = ["MultiHeadAttention", "dot_product_attention", "grouped_dot_product_attention", "resolve_impl"]
 
 
 def resolve_impl(impl: str, t: int, d: int) -> str:
@@ -79,18 +79,50 @@ def dot_product_attention(
     )
 
 
+def grouped_dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+) -> jax.Array:
+    """GQA attention: q (B, H, Tq, D) against k/v (B, Hkv, Tk, D) where
+    Hkv divides H — each kv head serves a group of H/Hkv query heads via a
+    grouped einsum (no materialized repeat of K/V). Float32 softmax."""
+    b, h, t_q, d = q.shape
+    h_kv, t_k = k.shape[1], k.shape[-2]
+    g = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    q5 = q.reshape(b, h_kv, g, t_q, d)
+    logits = jnp.einsum(
+        "bkgqd,bkmd->bkgqm", q5, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqm,bkmd->bkgqd", weights.astype(v.dtype), v)
+    return out.reshape(b, h, t_q, d)
+
+
 class MultiHeadAttention(Layer):
     """Self-attention with fused QKV projection.
 
     Parameters follow GPT-2 conventions: ``features`` is the model width,
     split across ``num_heads``. The QKV projection is one ``(d, 3d)`` matmul
     (a single MXU pass) and the output projection one ``(d, d)``.
+
+    ``num_kv_heads`` enables grouped-query attention (GQA; num_kv_heads=1 is
+    MQA): K/V get fewer heads, each shared by a group of query heads — the
+    KV cache and the K/V projection shrink by num_heads/num_kv_heads. GQA
+    runs on the grouped-einsum XLA path (the flash kernel and ring variant
+    require equal head counts; "auto" resolves accordingly).
     """
 
     def __init__(
         self,
         features: int,
         num_heads: int,
+        num_kv_heads: Optional[int] = None,
         causal: bool = True,
         dropout: float = 0.0,
         use_bias: bool = True,
@@ -104,15 +136,31 @@ class MultiHeadAttention(Layer):
             )
         if impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"MultiHeadAttention: unknown impl {impl!r}")
+        num_kv_heads = num_heads if num_kv_heads is None else num_kv_heads
+        if num_kv_heads < 1 or num_heads % num_kv_heads != 0:
+            raise ValueError(
+                f"MultiHeadAttention: num_kv_heads {num_kv_heads} must be a "
+                f"positive divisor of num_heads {num_heads}"
+            )
+        if num_kv_heads != num_heads and impl in ("flash", "ring"):
+            raise ValueError(
+                f"MultiHeadAttention: impl={impl!r} requires num_kv_heads == "
+                "num_heads (GQA runs on the grouped XLA path)"
+            )
         self.features = features
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
         self.head_dim = features // num_heads
         self.causal = causal
         self.dropout = dropout
         self.impl = impl
         self.seq_axis = seq_axis
         self._ring_mesh = None  # pinned at first ring trace
-        self.qkv = Dense(features, 3 * features, use_bias=use_bias)
+        self.qkv = Dense(
+            features,
+            (num_heads + 2 * num_kv_heads) * self.head_dim,
+            use_bias=use_bias,
+        )
         self.proj = Dense(
             features,
             features,
@@ -127,11 +175,36 @@ class MultiHeadAttention(Layer):
             "proj": self.proj.init(k2)["params"],
         }
 
+    def _split_heads(self, fused, b, t):
+        """(B, T, (H+2Hkv)*Dh) -> q (B, H, T, D), k/v (B, Hkv, T, D)."""
+        hw = self.num_heads * self.head_dim
+        kvw = self.num_kv_heads * self.head_dim
+        q = jnp.moveaxis(
+            fused[..., :hw].reshape(b, t, self.num_heads, self.head_dim), 1, 2
+        )
+        k = jnp.moveaxis(
+            fused[..., hw:hw + kvw].reshape(b, t, self.num_kv_heads, self.head_dim),
+            1, 2,
+        )
+        v = jnp.moveaxis(
+            fused[..., hw + kvw:].reshape(b, t, self.num_kv_heads, self.head_dim),
+            1, 2,
+        )
+        return q, k, v
+
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
         b, t, _ = x.shape
-        qkv, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
-        qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+        fused, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
+
+        if self.num_kv_heads != self.num_heads:
+            # GQA: grouped-einsum XLA path (flash/ring need equal heads).
+            q, k, v = self._split_heads(fused, b, t)
+            out = grouped_dot_product_attention(q, k, v, causal=self.causal)
+            out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)
+            return self._finish(p, out, b, t, mode, rng), variables["state"]
+
+        qkv = fused.reshape(b, t, 3, self.num_heads, self.head_dim)
 
         impl = resolve_impl(self.impl, t, self.head_dim)
         if impl == "flash":
@@ -175,7 +248,10 @@ class MultiHeadAttention(Layer):
             )  # each (B, H, T, D)
             out = dot_product_attention(q, k, v, causal=self.causal)
         out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)
+        return self._finish(p, out, b, t, mode, rng), variables["state"]
 
+    def _finish(self, p, out, b, t, mode, rng):
+        """Shared tail: attention dropout, head merge, output projection."""
         if self.dropout and mode == "train":
             if rng is None:
                 raise ValueError("MultiHeadAttention: dropout needs rng in train")
@@ -187,13 +263,15 @@ class MultiHeadAttention(Layer):
 
         out = out.reshape(b, t, self.features)
         out, _ = self.proj.apply({"params": p["proj"], "state": {}}, out)
-        return out, variables["state"]
+        return out
 
     # -- incremental decoding ---------------------------------------------
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32) -> dict:
-        """Empty KV cache for :meth:`apply_cached` ((B, H, T_max, D) pair)."""
-        shape = (batch, self.num_heads, max_len, self.head_dim)
+        """Empty KV cache for :meth:`apply_cached` — (B, Hkv, T_max, D)
+        pair; under GQA the cache is num_heads/num_kv_heads times smaller
+        (the point of GQA for decode)."""
+        shape = (batch, self.num_kv_heads, max_len, self.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def apply_cached(self, params, x, cache: dict, pos):
@@ -203,29 +281,39 @@ class MultiHeadAttention(Layer):
         step instead of recomputing the O(T^2) prefix. Returns
         (out, new_cache)."""
         b, s, _ = x.shape
-        qkv, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
-        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        fused, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
+        q, k, v = self._split_heads(fused, b, s)
 
         k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))
 
+        h_kv = self.num_kv_heads
+        g = self.num_heads // h_kv
         scale = 1.0 / math.sqrt(self.head_dim)
+        q5 = q.reshape(b, h_kv, g, s, self.head_dim)
         logits = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+            "bkgqd,bkmd->bkgqm", q5, k_cache,
+            preferred_element_type=jnp.float32,
         ) * scale
         # Query at position pos+i may see key positions <= pos+i.
         mask = (
             jnp.arange(k_cache.shape[-2])[None, :]
             <= pos + jnp.arange(s)[:, None]
         )
-        logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+        logits = jnp.where(mask[None, None, None, :, :], logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_cache.dtype), v_cache)
+        out = jnp.einsum(
+            "bkgqm,bkmd->bkgqd", weights.astype(v_cache.dtype), v_cache
+        ).reshape(b, self.num_heads, s, self.head_dim)
 
         out = jnp.moveaxis(out, 1, 2).reshape(b, s, self.features)
         out, _ = self.proj.apply({"params": params["proj"], "state": {}}, out)
         return out, {"k": k_cache, "v": v_cache}
 
     def __repr__(self):
-        return f"MultiHeadAttention(d={self.features}, h={self.num_heads})"
+        kv = (
+            f", kv={self.num_kv_heads}"
+            if self.num_kv_heads != self.num_heads
+            else ""
+        )
+        return f"MultiHeadAttention(d={self.features}, h={self.num_heads}{kv})"

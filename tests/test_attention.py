@@ -96,3 +96,47 @@ def test_ring_attention_data_and_seq_axes():
     np.testing.assert_allclose(
         np.asarray(ringed), np.asarray(full), rtol=2e-4, atol=2e-4
     )
+
+
+def test_grouped_attention_matches_repeated_kv():
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.nn.attention import (
+        dot_product_attention,
+        grouped_dot_product_attention,
+    )
+
+    k0 = jax.random.key(0)
+    b, h, hkv, t, d = 2, 8, 2, 16, 4
+    q = jax.random.normal(jax.random.fold_in(k0, 0), (b, h, t, d))
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (b, hkv, t, d))
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (b, hkv, t, d))
+    grouped = grouped_dot_product_attention(q, k, v, causal=True)
+    full = dot_product_attention(
+        q, jnp.repeat(k, h // hkv, axis=1), jnp.repeat(v, h // hkv, axis=1),
+        causal=True,
+    )
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(full), atol=1e-5)
+
+
+def test_gqa_layer_shapes_cache_and_validation():
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.nn.attention import MultiHeadAttention
+
+    attn = MultiHeadAttention(32, num_heads=8, num_kv_heads=2, dropout=0.0)
+    variables = attn.init(jax.random.key(0))
+    # Fused projection: q (8 heads) + k,v (2 heads each) of head_dim 4.
+    assert variables["params"]["qkv"]["w"].shape == (32, (8 + 2 * 2) * 4)
+    out, _ = attn.apply(variables, jnp.ones((2, 16, 32)), mode="eval")
+    assert out.shape == (2, 16, 32)
+    cache = attn.init_cache(2, 16)
+    assert cache["k"].shape == (2, 2, 16, 4)  # num_kv_heads, not num_heads
+
+    for bad in (3, 0, -1):
+        with pytest.raises(ValueError, match="positive divisor"):
+            MultiHeadAttention(32, num_heads=8, num_kv_heads=bad)
+    with pytest.raises(ValueError, match="requires num_kv_heads"):
+        MultiHeadAttention(32, num_heads=8, num_kv_heads=2, impl="flash")
