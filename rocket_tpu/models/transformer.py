@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from rocket_tpu import nn
 from rocket_tpu.nn.attention import MultiHeadAttention
-from rocket_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm
+from rocket_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm, RMSNorm
 from rocket_tpu.nn.module import Layer, Model, Variables
 
 __all__ = ["TransformerConfig", "TransformerLM", "Block", "next_token_loss", "generate"]
@@ -80,6 +80,16 @@ class TransformerConfig:
     #: ENTIRE model to f32 compute (≈2x MXU time). Params stay f32 masters;
     #: layernorm/softmax math stays f32 internally.
     activation_dtype: Optional[str] = None
+    #: Positional encoding: "learned" (GPT-2 wpe table) or "rope" (rotary,
+    #: applied to q/k inside attention; no wpe params). RoPE is the
+    #: Llama-family default and composes with num_kv_heads (GQA).
+    pos_embedding: str = "learned"
+    rope_base: float = 10000.0
+    #: Normalizer: "layernorm" (GPT-2) or "rmsnorm" (Llama family).
+    norm: str = "layernorm"
+    #: Block FFN: "gelu" (GPT-2, fc_in 4x + gelu + fc_out) or "swiglu"
+    #: (Llama family: fused gate+up projection, silu(gate) * up, down).
+    mlp: str = "gelu"
     #: Fused head+cross-entropy chunk size (0 = off). In train mode the
     #: model skips materializing (B, T, V) logits and instead computes the
     #: next-token NLL directly (``batch["nll"]``), scanning the head
@@ -90,6 +100,13 @@ class TransformerConfig:
     #: largest single allocation in the step. ``next_token_loss`` consumes
     #: either form. Eval mode always materializes logits (metrics need them).
     loss_chunk: int = 0
+
+    def norm_cls(self):
+        """The configured normalizer class — single source of truth for
+        Block (ln1/ln2) and TransformerLM (ln_f)."""
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"TransformerConfig: unknown norm {self.norm!r}")
+        return RMSNorm if self.norm == "rmsnorm" else LayerNorm
 
     @staticmethod
     def char_lm(vocab_size: int = 128, max_seq_len: int = 256) -> "TransformerConfig":
@@ -124,12 +141,21 @@ class Block(Layer):
 
     def __init__(self, config: TransformerConfig, layer_idx: int):
         c = config
-        self.ln1 = LayerNorm(c.dim)
+        if c.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"TransformerConfig: unknown mlp {c.mlp!r}")
+        if c.num_experts > 0 and c.mlp != "gelu":
+            raise ValueError(
+                f"TransformerConfig: mlp={c.mlp!r} has no effect with "
+                "num_experts > 0 (the MoE brings its own FFN)"
+            )
+        norm_cls = c.norm_cls()
+        self.ln1 = norm_cls(c.dim)
         self.attn = MultiHeadAttention(
             c.dim, c.num_heads, num_kv_heads=c.num_kv_heads, causal=True,
             dropout=c.dropout, impl=c.attention_impl, seq_axis=c.seq_axis,
+            rope=c.pos_embedding == "rope", rope_base=c.rope_base,
         )
-        self.ln2 = LayerNorm(c.dim)
+        self.ln2 = norm_cls(c.dim)
         if c.num_experts > 0:
             from rocket_tpu.nn.moe import MoE
 
@@ -141,8 +167,12 @@ class Block(Layer):
             self.fc_in = self.fc_out = None
         else:
             self.moe = None
-            self.fc_in = Dense(c.dim, c.mlp_ratio * c.dim)
-            self.fc_out = Dense(c.mlp_ratio * c.dim, c.dim)
+            hidden = c.mlp_ratio * c.dim
+            # swiglu: one fused (gate|up) projection, halves split in apply.
+            fc_in_width = 2 * hidden if c.mlp == "swiglu" else hidden
+            self.fc_in = Dense(c.dim, fc_in_width)
+            self.fc_out = Dense(hidden, c.dim)
+        self.mlp_type = c.mlp
         self.dropout = Dropout(c.dropout) if c.dropout else None
         # GPT-2: residual projections scaled by 1/sqrt(2*num_layers).
         self._resid_scale = (2 * c.num_layers) ** -0.5
@@ -196,9 +226,7 @@ class Block(Layer):
             h, moe_out = self.moe.apply({"params": p["moe"], "state": {}}, h)
             aux = moe_out["aux_loss"]
         else:
-            h, _ = self.fc_in.apply({"params": p["mlp"]["fc_in"], "state": {}}, h)
-            h = jax.nn.gelu(h)
-            h, _ = self.fc_out.apply({"params": p["mlp"]["fc_out"], "state": {}}, h)
+            h = self._mlp(p["mlp"], h)
         if self.dropout is not None:
             h, _ = self.dropout.apply({"params": {}, "state": {}}, h, mode=mode, rng=rngs[2])
         if aux is not None:
@@ -220,10 +248,18 @@ class Block(Layer):
         if self.moe is not None:
             h, _ = self.moe.apply({"params": params["moe"], "state": {}}, h)
         else:
-            h, _ = self.fc_in.apply({"params": params["mlp"]["fc_in"], "state": {}}, h)
-            h = jax.nn.gelu(h)
-            h, _ = self.fc_out.apply({"params": params["mlp"]["fc_out"], "state": {}}, h)
+            h = self._mlp(params["mlp"], h)
         return x + h, cache
+
+    def _mlp(self, p, h):
+        h, _ = self.fc_in.apply({"params": p["fc_in"], "state": {}}, h)
+        if self.mlp_type == "swiglu":
+            gate, up = jnp.split(h, 2, axis=-1)
+            h = jax.nn.silu(gate) * up
+        else:
+            h = jax.nn.gelu(h)
+        h, _ = self.fc_out.apply({"params": p["fc_out"], "state": {}}, h)
+        return h
 
 
 class TransformerLM(Model):
@@ -243,9 +279,18 @@ class TransformerLM(Model):
     ):
         self.config = config
         self.wte = Embedding(config.vocab_size, config.dim)
-        self.wpe = Embedding(config.max_seq_len, config.dim)
+        # RoPE encodes positions inside attention — no learned wpe table.
+        self.wpe = (
+            None
+            if config.pos_embedding == "rope"
+            else Embedding(config.max_seq_len, config.dim)
+        )
+        if config.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"TransformerConfig: unknown pos_embedding {config.pos_embedding!r}"
+            )
         self.blocks = [Block(config, i) for i in range(config.num_layers)]
-        self.ln_f = LayerNorm(config.dim)
+        self.ln_f = config.norm_cls()(config.dim)
         self.head = (
             None
             if config.tied_embeddings
@@ -264,9 +309,10 @@ class TransformerLM(Model):
         ]
         params = {
             "wte": self.wte.init(keys[0])["params"],
-            "wpe": self.wpe.init(keys[1])["params"],
             "ln_f": self.ln_f.init(keys[-1])["params"],
         }
+        if self.wpe is not None:
+            params["wpe"] = self.wpe.init(keys[1])["params"]
         if self.config.scan_layers:
             # One stacked subtree with a leading L dim — the scan's xs.
             params["blocks_stacked"] = jax.tree.map(
@@ -303,7 +349,8 @@ class TransformerLM(Model):
         p = params
         s = tokens.shape[1]
         x = jnp.take(p["wte"]["table"], tokens, axis=0)
-        x = x + jax.lax.dynamic_slice_in_dim(p["wpe"]["table"], pos, s, axis=0)
+        if self.wpe is not None:
+            x = x + jax.lax.dynamic_slice_in_dim(p["wpe"]["table"], pos, s, axis=0)
         if self.config.activation_dtype is not None:
             x = x.astype(self.config.activation_dtype)
 
@@ -406,7 +453,8 @@ class TransformerLM(Model):
             )
 
         x = jnp.take(p["wte"]["table"], tokens, axis=0)
-        x = x + p["wpe"]["table"][:t]
+        if self.wpe is not None:
+            x = x + p["wpe"]["table"][:t]
         if self.config.activation_dtype is not None:
             x = x.astype(self.config.activation_dtype)
         if self.drop is not None:

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from rocket_tpu.nn.layers import Dense
 from rocket_tpu.nn.module import Layer
 
-__all__ = ["MultiHeadAttention", "dot_product_attention", "grouped_dot_product_attention", "resolve_impl"]
+__all__ = ["MultiHeadAttention", "apply_rope", "dot_product_attention", "grouped_dot_product_attention", "resolve_impl"]
 
 
 def resolve_impl(impl: str, t: int, d: int) -> str:
@@ -79,6 +79,24 @@ def dot_product_attention(
     )
 
 
+def apply_rope(x: jax.Array, offset=0, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on (B, H, T, D), rotate-half convention.
+
+    Positions are ``offset .. offset+T`` — ``offset`` may be a traced scalar
+    (cached decode). Trig in f32, result cast back to x.dtype. Keys are
+    rotated BEFORE caching, so cached decode needs no re-rotation."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = offset + jnp.arange(x.shape[-2])
+    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]  # (T, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def grouped_dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -128,6 +146,8 @@ class MultiHeadAttention(Layer):
         use_bias: bool = True,
         impl: str = "auto",
         seq_axis: str = "seq",
+        rope: bool = False,
+        rope_base: float = 10000.0,
     ):
         if features % num_heads != 0:
             raise ValueError(
@@ -147,6 +167,16 @@ class MultiHeadAttention(Layer):
                 f"MultiHeadAttention: impl={impl!r} requires num_kv_heads == "
                 "num_heads (GQA runs on the grouped XLA path)"
             )
+        if rope and (features // num_heads) % 2 != 0:
+            raise ValueError("MultiHeadAttention: rope needs an even head_dim")
+        if rope and impl == "ring":
+            # Under ring the sequence is sharded; local position offsets
+            # would silently rotate with the wrong absolute positions.
+            raise ValueError(
+                "MultiHeadAttention: rope is not supported with impl='ring'"
+            )
+        self.rope = rope
+        self.rope_base = rope_base
         self.features = features
         self.num_heads = num_heads
         self.num_kv_heads = num_kv_heads
@@ -197,10 +227,24 @@ class MultiHeadAttention(Layer):
         b, t, _ = x.shape
         fused, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
 
-        if self.num_kv_heads != self.num_heads:
-            # GQA: grouped-einsum XLA path (flash/ring need equal heads).
+        if self.num_kv_heads != self.num_heads or self.rope:
+            # Split-heads path: GQA (grouped einsum; flash/ring need equal
+            # heads) and/or RoPE (q/k rotated before attention — the flash
+            # kernel consumes the rotated stack unchanged).
             q, k, v = self._split_heads(fused, b, t)
-            out = grouped_dot_product_attention(q, k, v, causal=self.causal)
+            if self.rope:
+                q = apply_rope(q, 0, self.rope_base)
+                k = apply_rope(k, 0, self.rope_base)
+            if self.num_kv_heads != self.num_heads:
+                out = grouped_dot_product_attention(q, k, v, causal=self.causal)
+            elif resolve_impl(self.impl, t, self.head_dim) == "flash":
+                from rocket_tpu.ops.flash_attention import flash_attention_qkv
+
+                out = flash_attention_qkv(
+                    jnp.stack([q, k, v]), causal=self.causal
+                )
+            else:
+                out = dot_product_attention(q, k, v, causal=self.causal)
             out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)
             return self._finish(p, out, b, t, mode, rng), variables["state"]
 
@@ -283,6 +327,11 @@ class MultiHeadAttention(Layer):
         b, s, _ = x.shape
         fused, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
         q, k, v = self._split_heads(fused, b, s)
+        if self.rope:
+            # Absolute positions [pos, pos+S); keys enter the cache already
+            # rotated, so earlier entries never need re-rotation.
+            q = apply_rope(q, pos, self.rope_base)
+            k = apply_rope(k, pos, self.rope_base)
 
         k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))

@@ -234,6 +234,28 @@ class LayerNorm(Layer):
         return f"LayerNorm({self.num_features})"
 
 
+class RMSNorm(Layer):
+    """Root-mean-square norm (no centering, no bias) — the Llama-family
+    normalizer. f32 statistics inside any compute dtype, like LayerNorm."""
+
+    def __init__(self, num_features: int, eps: float = 1e-6):
+        self.num_features = num_features
+        self.eps = eps
+
+    def init_params(self, key):
+        return {"scale": jnp.ones((self.num_features,), jnp.float32)}
+
+    def apply(self, variables, x, *, mode="train", rng=None):
+        p = variables["params"]
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(ms + self.eps) * p["scale"]
+        return y.astype(x.dtype), variables["state"]
+
+    def __repr__(self):
+        return f"RMSNorm({self.num_features})"
+
+
 class Embedding(Layer):
     def __init__(
         self,
