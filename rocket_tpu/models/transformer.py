@@ -101,6 +101,23 @@ class TransformerConfig:
     #: either form. Eval mode always materializes logits (metrics need them).
     loss_chunk: int = 0
 
+    def validate(self) -> None:
+        """Config-level knob validation — called by TransformerLM and Block
+        so a bad value fails fast regardless of which submodule is built."""
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"TransformerConfig: unknown norm {self.norm!r}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"TransformerConfig: unknown mlp {self.mlp!r}")
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"TransformerConfig: unknown pos_embedding {self.pos_embedding!r}"
+            )
+        if self.num_experts > 0 and self.mlp != "gelu":
+            raise ValueError(
+                f"TransformerConfig: mlp={self.mlp!r} has no effect with "
+                "num_experts > 0 (the MoE brings its own FFN)"
+            )
+
     def norm_cls(self):
         """The configured normalizer class — single source of truth for
         Block (ln1/ln2) and TransformerLM (ln_f)."""
@@ -141,13 +158,7 @@ class Block(Layer):
 
     def __init__(self, config: TransformerConfig, layer_idx: int):
         c = config
-        if c.mlp not in ("gelu", "swiglu"):
-            raise ValueError(f"TransformerConfig: unknown mlp {c.mlp!r}")
-        if c.num_experts > 0 and c.mlp != "gelu":
-            raise ValueError(
-                f"TransformerConfig: mlp={c.mlp!r} has no effect with "
-                "num_experts > 0 (the MoE brings its own FFN)"
-            )
+        c.validate()
         norm_cls = c.norm_cls()
         self.ln1 = norm_cls(c.dim)
         self.attn = MultiHeadAttention(
@@ -254,7 +265,14 @@ class Block(Layer):
     def _mlp(self, p, h):
         h, _ = self.fc_in.apply({"params": p["fc_in"], "state": {}}, h)
         if self.mlp_type == "swiglu":
-            gate, up = jnp.split(h, 2, axis=-1)
+            # INTERLEAVED gate/up channels (gate = even, up = odd), not a
+            # midpoint split: under tensor parallelism fc_in's output dim is
+            # sharded, and a midpoint split would put all gate channels on
+            # the first half of the shards — silu(gate)*up would force an
+            # all-gather of the widest activation in the block. Interleaved,
+            # every gate channel sits next to its up channel on the same
+            # shard and the product stays column-parallel.
+            gate, up = h[..., 0::2], h[..., 1::2]
             h = jax.nn.silu(gate) * up
         else:
             h = jax.nn.gelu(h)
@@ -279,16 +297,13 @@ class TransformerLM(Model):
     ):
         self.config = config
         self.wte = Embedding(config.vocab_size, config.dim)
+        config.validate()
         # RoPE encodes positions inside attention — no learned wpe table.
         self.wpe = (
             None
             if config.pos_embedding == "rope"
             else Embedding(config.max_seq_len, config.dim)
         )
-        if config.pos_embedding not in ("learned", "rope"):
-            raise ValueError(
-                f"TransformerConfig: unknown pos_embedding {config.pos_embedding!r}"
-            )
         self.blocks = [Block(config, i) for i in range(config.num_layers)]
         self.ln_f = config.norm_cls()(config.dim)
         self.head = (
