@@ -78,16 +78,23 @@ def test_char_lm_learns(runtime8):
     assert losses[-1] < np.log(tok.vocab_size) * 0.9
 
 
-@pytest.mark.parametrize("rules", ["tp", "fsdp"])
+@pytest.mark.parametrize("rules", ["tp", "fsdp", "tp_llama"])
 def test_sharded_training_compiles_and_runs(tmp_path, rules):
     runtime = Runtime(
-        mesh_shape={"data": 4, "model": 2} if rules == "tp" else {"data": 8},
+        mesh_shape={"data": 8} if rules == "fsdp" else {"data": 4, "model": 2},
         seed=0,
         project_dir=str(tmp_path),
     )
     config = tiny_config()
+    if rules == "tp_llama":
+        # The second model family under tensor parallelism — notably the
+        # interleaved swiglu gate/up split staying column-parallel.
+        config.pos_embedding = "rope"
+        config.norm = "rmsnorm"
+        config.mlp = "swiglu"
+        config.num_kv_heads = 2
     model = TransformerLM(config)
-    rule_fn = gpt2_tp_rules() if rules == "tp" else fsdp_rules(min_size=0)
+    rule_fn = fsdp_rules(min_size=0) if rules == "fsdp" else gpt2_tp_rules()
     rng = np.random.default_rng(0)
     data = TokenDataset(rng.integers(0, 64, size=4096).astype(np.int32), seq_len=32)
     module = rt.Module(
@@ -119,7 +126,7 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
         runtime=runtime,
     ).launch()
     # Params kept their sharded layout through training.
-    if rules == "tp":
+    if rules in ("tp", "tp_llama"):
         assert "model" in seen["spec"], seen
         assert "model" in seen["mu_spec"], seen
     else:
