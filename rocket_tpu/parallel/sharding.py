@@ -75,6 +75,8 @@ def gpt2_tp_rules(axis: str = "model") -> RuleFn:
             ("*/attn/proj/w", (axis, None)),
             ("*/mlp/fc_in/w", (None, axis)),
             ("*/mlp/fc_in/b", (axis,)),
+            ("*/mlp/fc_gate/w", (None, axis)),
+            ("*/mlp/fc_gate/b", (axis,)),
             ("*/mlp/fc_out/w", (axis, None)),
             ("wte/table", (axis, None)),
             ("head/w", (None, axis)),

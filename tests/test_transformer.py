@@ -88,7 +88,7 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
     config = tiny_config()
     if rules == "tp_llama":
         # The second model family under tensor parallelism — notably the
-        # interleaved swiglu gate/up split staying column-parallel.
+        # separate swiglu gate/up projections sharding column-parallel.
         config.pos_embedding = "rope"
         config.norm = "rmsnorm"
         config.mlp = "swiglu"
@@ -118,6 +118,9 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
             seen["mu_spec"] = str(
                 mu["blocks"]["0"]["attn"]["qkv"]["w"].sharding.spec
             )
+            mlp_p = module.state["params"]["blocks"]["0"]["mlp"]
+            if "fc_gate" in mlp_p:
+                seen["gate_spec"] = str(mlp_p["fc_gate"]["w"].sharding.spec)
 
     rt.Launcher(
         [rt.Looper([rt.Dataset(data, batch_size=16), module, ShardSpy()],
@@ -129,6 +132,9 @@ def test_sharded_training_compiles_and_runs(tmp_path, rules):
     if rules in ("tp", "tp_llama"):
         assert "model" in seen["spec"], seen
         assert "model" in seen["mu_spec"], seen
+        if rules == "tp_llama":
+            # The new fc_gate rule actually sharded the gate kernel.
+            assert "model" in seen["gate_spec"], seen
     else:
         assert "data" in seen["mu_spec"], seen
 
@@ -503,8 +509,9 @@ def test_llama_style_lm_trains_and_generates():
     variables = model.init(jax.random.key(0))
     assert "wpe" not in variables["params"]  # rope has no learned positions
     assert "bias" not in variables["params"]["ln_f"]  # rmsnorm: scale only
-    w = variables["params"]["blocks"]["0"]["mlp"]["fc_in"]["w"]
-    assert w.shape == (32, 2 * 4 * 32)  # fused gate|up projection
+    mlp_params = variables["params"]["blocks"]["0"]["mlp"]
+    assert mlp_params["fc_in"]["w"].shape == (32, 4 * 32)   # up projection
+    assert mlp_params["fc_gate"]["w"].shape == (32, 4 * 32)  # gate projection
 
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
 
