@@ -131,9 +131,12 @@ class MultiHeadAttention(Layer):
 
     ``num_kv_heads`` enables grouped-query attention (GQA; num_kv_heads=1 is
     MQA): K/V get fewer heads, each shared by a group of query heads — the
-    KV cache and the K/V projection shrink by num_heads/num_kv_heads. GQA
-    runs on the grouped-einsum XLA path (the flash kernel and ring variant
-    require equal head counts; "auto" resolves accordingly).
+    KV cache and the K/V projection shrink by num_heads/num_kv_heads.
+    Training attention rides the flash kernel (K/V broadcast to full heads
+    — GQA doesn't shrink attention FLOPs, only the projection and decode
+    cache) when shapes allow, else the grouped-einsum XLA path; cached
+    decode always uses the grouped path on the small cache. The ring
+    variant requires equal head counts.
     """
 
     def __init__(
@@ -162,10 +165,10 @@ class MultiHeadAttention(Layer):
                 f"MultiHeadAttention: num_kv_heads {num_kv_heads} must be a "
                 f"positive divisor of num_heads {num_heads}"
             )
-        if num_kv_heads != num_heads and impl in ("flash", "ring"):
+        if num_kv_heads != num_heads and impl == "ring":
             raise ValueError(
-                f"MultiHeadAttention: impl={impl!r} requires num_kv_heads == "
-                "num_heads (GQA runs on the grouped XLA path)"
+                "MultiHeadAttention: impl='ring' requires num_kv_heads == "
+                "num_heads"
             )
         if rope and (features // num_heads) % 2 != 0:
             raise ValueError("MultiHeadAttention: rope needs an even head_dim")
@@ -235,14 +238,31 @@ class MultiHeadAttention(Layer):
             if self.rope:
                 q = apply_rope(q, 0, self.rope_base)
                 k = apply_rope(k, 0, self.rope_base)
-            if self.num_kv_heads != self.num_heads:
-                out = grouped_dot_product_attention(q, k, v, causal=self.causal)
-            elif resolve_impl(self.impl, t, self.head_dim) == "flash":
+            use_flash = resolve_impl(self.impl, t, self.head_dim) == "flash"
+            if use_flash:
                 from rocket_tpu.ops.flash_attention import flash_attention_qkv
-
-                out = flash_attention_qkv(
-                    jnp.stack([q, k, v]), causal=self.causal
-                )
+            if self.num_kv_heads != self.num_heads:
+                if use_flash:
+                    # Training-time GQA rides the flash kernel by repeating
+                    # K/V to full heads: GQA doesn't shrink the attention
+                    # FLOPs anyway (only the K/V projection and the decode
+                    # cache), and the broadcast copy is far cheaper than
+                    # the XLA path's materialized (T, T) score tensors.
+                    g = self.num_heads // self.num_kv_heads
+                    out = flash_attention_qkv(
+                        jnp.stack([
+                            q,
+                            jnp.repeat(k, g, axis=1),
+                            jnp.repeat(v, g, axis=1),
+                        ]),
+                        causal=self.causal,
+                    )
+                else:
+                    out = grouped_dot_product_attention(
+                        q, k, v, causal=self.causal
+                    )
+            elif use_flash:
+                out = flash_attention_qkv(jnp.stack([q, k, v]), causal=self.causal)
             else:
                 out = dot_product_attention(q, k, v, causal=self.causal)
             out = jnp.moveaxis(out, 1, 2)  # (B, T, H, D)

@@ -139,4 +139,4 @@ def test_gqa_layer_shapes_cache_and_validation():
         with pytest.raises(ValueError, match="positive divisor"):
             MultiHeadAttention(32, num_heads=8, num_kv_heads=bad)
     with pytest.raises(ValueError, match="requires num_kv_heads"):
-        MultiHeadAttention(32, num_heads=8, num_kv_heads=2, impl="flash")
+        MultiHeadAttention(32, num_heads=8, num_kv_heads=2, impl="ring")
