@@ -38,7 +38,9 @@ class TransformerConfig:
     num_heads: int
     #: Grouped-query attention: K/V heads (None = num_heads = standard MHA;
     #: 1 = MQA). Shrinks the KV cache and K/V projection by
-    #: num_heads/num_kv_heads; runs on the grouped XLA attention path.
+    #: num_heads/num_kv_heads. Training attention uses the flash kernel
+    #: (K/V broadcast to full heads) when shapes allow, else a grouped
+    #: einsum; cached decode always runs grouped on the small cache.
     num_kv_heads: Optional[int] = None
     mlp_ratio: int = 4
     dropout: float = 0.0

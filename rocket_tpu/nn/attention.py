@@ -231,9 +231,9 @@ class MultiHeadAttention(Layer):
         fused, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
 
         if self.num_kv_heads != self.num_heads or self.rope:
-            # Split-heads path: GQA (grouped einsum; flash/ring need equal
-            # heads) and/or RoPE (q/k rotated before attention — the flash
-            # kernel consumes the rotated stack unchanged).
+            # Split-heads path: GQA (flash via K/V head broadcast, else the
+            # grouped einsum) and/or RoPE (q/k rotated before attention —
+            # the flash kernel consumes the rotated stack unchanged).
             q, k, v = self._split_heads(fused, b, t)
             if self.rope:
                 q = apply_rope(q, 0, self.rope_base)

@@ -140,3 +140,26 @@ def test_gqa_layer_shapes_cache_and_validation():
             MultiHeadAttention(32, num_heads=8, num_kv_heads=bad)
     with pytest.raises(ValueError, match="requires num_kv_heads"):
         MultiHeadAttention(32, num_heads=8, num_kv_heads=2, impl="ring")
+
+
+def test_gqa_flash_matches_grouped_path():
+    """The flash-via-broadcast GQA route (interpret mode on CPU) must match
+    the grouped-einsum path bit-for-tolerance on the same layer params."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.nn.attention import MultiHeadAttention
+
+    flash_attn = MultiHeadAttention(
+        32, num_heads=4, num_kv_heads=2, dropout=0.0, impl="flash"
+    )
+    grouped_attn = MultiHeadAttention(
+        32, num_heads=4, num_kv_heads=2, dropout=0.0, impl="xla"
+    )
+    variables = flash_attn.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 128, 32))
+    out_flash, _ = flash_attn.apply(variables, x, mode="eval")
+    out_grouped, _ = grouped_attn.apply(variables, x, mode="eval")
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_grouped), atol=2e-5
+    )
