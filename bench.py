@@ -8,6 +8,8 @@ goes to stderr):
                    metric: tok/sec/chip + MFU.
 * ``gpt2_350m``  — GPT-2 medium (d=1024, ~354M params): the wider matmuls
                    fill the MXU better — the framework's best-MFU config.
+* ``llama``      — Llama-family 124M-class (RoPE + RMSNorm + SwiGLU +
+                   GQA-4): the second model family's throughput.
 * ``charlm``     — TinyShakespeare char-transformer, B=128, T=256
                    (configs[2]): tok/sec/chip + MFU.
 * ``resnet18``   — CIFAR-10 ResNet-18, B=256 (configs[1]): samples/sec/chip.
@@ -308,9 +310,16 @@ def bench_gpt2_350m(warmup=4, steps=15):
     return _bench_lm(config, batch=8, warmup=warmup, steps=steps, name="gpt2_350m")
 
 
+def bench_llama(warmup=4, steps=15):
+    # Second model family: RoPE + RMSNorm + SwiGLU + GQA (124M-class dims).
+    config = TransformerConfig.llama_style()
+    return _bench_lm(config, batch=8, warmup=warmup, steps=steps, name="llama_style")
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
     "gpt2_350m": bench_gpt2_350m,
+    "llama": bench_llama,
     "charlm": bench_charlm,
     "resnet18": bench_resnet18,
     "resnet50": bench_resnet50,
@@ -355,6 +364,7 @@ def _require_live_backend(headline_metric: str, timeout_s: float = 120.0) -> Non
 METRIC_NAMES = {
     "gpt2": "gpt2_124m_tok_per_sec_per_chip",
     "gpt2_350m": "gpt2_350m_tok_per_sec_per_chip",
+    "llama": "llama_style_tok_per_sec_per_chip",
     "charlm": "charlm_tok_per_sec_per_chip",
     "resnet18": "cifar_resnet18_samples_per_sec_per_chip",
     "resnet50": "imagenet_resnet50_samples_per_sec_per_chip",

@@ -1,0 +1,101 @@
+"""Llama-family char LM — the second model family, end to end.
+
+Same capsule tree as ``char_lm.py`` but the model uses the Llama recipe:
+RoPE positions (no learned table), RMSNorm, SwiGLU FFN, grouped-query
+attention (half the K/V heads -> half the KV cache in decode), untied
+head, gradient clipping, and nucleus sampling at the end. Runs anywhere:
+the real chip, or ``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python
+examples/llama_lm.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.text import CharTokenizer, TokenDataset, tiny_shakespeare
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    generate,
+    next_token_loss,
+)
+
+
+def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
+    text = tiny_shakespeare()
+    tok = CharTokenizer(text)
+    tokens = tok.encode(text)
+    train_data = TokenDataset(tokens, seq_len=seq_len)
+
+    runtime = rt.Runtime(seed=0)
+    config = TransformerConfig.llama_style(
+        vocab_size=tok.vocab_size, max_seq_len=seq_len,
+        dim=256, num_layers=6, num_heads=8, num_kv_heads=4,
+    )
+    config.loss_chunk = 64
+    model = TransformerLM(config)
+
+    steps_per_epoch = len(train_data) // batch_size
+    total_steps = max(1, steps_per_epoch * num_epochs)
+
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(next_token_loss()),
+            rt.Optimizer(optim.adamw(weight_decay=0.1), clip_norm=1.0),
+            rt.Scheduler(
+                optim.warmup_cosine_lr(
+                    3e-4, warmup_steps=max(1, total_steps // 20),
+                    decay_steps=total_steps,
+                )
+            ),
+        ],
+    )
+
+    trained = {}
+
+    class Keep(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=10)
+
+        def launch(self, attrs=None):
+            trained["params"] = module.state["params"]
+
+    rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(train_data, batch_size=batch_size, shuffle=True,
+                               drop_last=True),
+                    module,
+                    Keep(),
+                    rt.Checkpointer(output_dir="checkpoints/llama_lm", save_every=500),
+                ],
+                tag="train",
+            ),
+        ],
+        num_epochs=num_epochs,
+        statefull=True,
+        runtime=runtime,
+    ).launch()
+    print(f"vocab={tok.vocab_size} steps={total_steps}")
+
+    # Nucleus sampling through the GQA KV cache (half-size by design).
+    prompt = tok.encode("the ")[None, :]
+    max_new = min(64, config.max_seq_len - prompt.shape[1])
+    out = generate(
+        model, {"params": trained["params"], "state": {}}, prompt, max_new,
+        key=jax.random.key(0), temperature=0.8, top_p=0.9,
+    )
+    print("sample:", tok.decode(np.asarray(out[0])))
+
+
+if __name__ == "__main__":
+    main()

@@ -144,6 +144,25 @@ class TransformerConfig:
         )
 
     @staticmethod
+    def llama_style(
+        vocab_size: int = 50257,
+        max_seq_len: int = 1024,
+        dim: int = 768,
+        num_layers: int = 12,
+        num_heads: int = 12,
+        num_kv_heads: int = 4,
+    ) -> "TransformerConfig":
+        """Llama-family recipe at any size: RoPE positions, RMSNorm,
+        SwiGLU FFN, grouped-query attention, untied head."""
+        return TransformerConfig(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            dim=dim, num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, pos_embedding="rope", norm="rmsnorm",
+            mlp="swiglu", tied_embeddings=False, dropout=0.0,
+            activation_dtype="bfloat16", loss_chunk=128,
+        )
+
+    @staticmethod
     def gpt2_350m(vocab_size: int = 50257, max_seq_len: int = 1024) -> "TransformerConfig":
         """GPT-2 medium (~354M params). The wider (d=1024) matmuls fill the
         MXU better than 124M: measured ~51% single-chip MFU where the same
