@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["CharTokenizer", "TokenDataset", "tiny_shakespeare", "synthetic_corpus"]
+__all__ = ["BPETokenizer", "CharTokenizer", "TokenDataset", "tiny_shakespeare", "synthetic_corpus"]
 
 
 def synthetic_corpus(num_chars: int = 1_000_000, seed: int = 0) -> str:
@@ -71,6 +71,159 @@ class CharTokenizer:
 
     def decode(self, tokens) -> str:
         return "".join(self.vocab[int(t)] for t in tokens)
+
+
+class BPETokenizer:
+    """Byte-level BPE trained from a corpus — no external vocab files.
+
+    Classic algorithm: chunks (words / whitespace runs, so decode is
+    lossless) start as byte sequences; the most frequent adjacent symbol
+    pair is merged repeatedly until ``vocab_size``. IDs 0-255 are raw
+    bytes, merged tokens follow. Any text round-trips (unseen bytes fall
+    back to their byte tokens). Save/load via a JSON merges list.
+    """
+
+    def __init__(self, merges):
+        #: merge list in creation order: [(id_a, id_b), ...]
+        self.merges = [tuple(m) for m in merges]
+        self._ranks = {pair: i for i, pair in enumerate(self.merges)}
+        # token id -> bytes
+        self.vocab = [bytes([i]) for i in range(256)]
+        for a, b in self.merges:
+            self.vocab.append(self.vocab[a] + self.vocab[b])
+        self.vocab_size = len(self.vocab)
+        self._chunk_cache = {}
+
+    # -- training ----------------------------------------------------------
+
+    @classmethod
+    def train(cls, text: str, vocab_size: int) -> "BPETokenizer":
+        """Incremental BPE training: pair counts update only for the chunk
+        sequences a merge touches, and the best pair comes from a
+        lazy-deletion heap — realistic vocabs (tens of thousands) train in
+        seconds instead of re-scanning the whole corpus per merge."""
+        if vocab_size < 256:
+            raise ValueError("BPETokenizer: vocab_size must be >= 256")
+        import collections
+        import heapq
+        import re
+
+        # Alternate word / whitespace chunks -> lossless decode; merges
+        # never cross chunk boundaries (the GPT-2 recipe, simplified).
+        chunk_freq = collections.Counter(re.findall(r"\S+|\s+", text))
+        seqs = [tuple(chunk.encode("utf-8")) for chunk in chunk_freq]
+        freqs = list(chunk_freq.values())
+
+        pair_counts = collections.Counter()
+        where = collections.defaultdict(set)  # pair -> seq indices (may go stale)
+        for i, seq in enumerate(seqs):
+            for pair in zip(seq, seq[1:]):
+                pair_counts[pair] += freqs[i]
+                where[pair].add(i)
+        # Max-heap with lazy deletion: entries go stale when counts change;
+        # tie-break on the pair itself for determinism.
+        heap = [(-c, p) for p, c in pair_counts.items()]
+        heapq.heapify(heap)
+
+        def push(pair):
+            heapq.heappush(heap, (-pair_counts[pair], pair))
+
+        merges = []
+        next_id = 256
+        while next_id < vocab_size and heap:
+            neg, best = heapq.heappop(heap)
+            count = pair_counts.get(best, 0)
+            if count <= 0:
+                continue
+            if -neg != count:
+                push(best)  # stale entry — reinsert with the live count
+                continue
+            merges.append(best)
+            for i in sorted(where.pop(best, ())):
+                seq, f = seqs[i], freqs[i]
+                if best not in zip(seq, seq[1:]):
+                    continue  # stale index
+                touched = set()
+                for pair in zip(seq, seq[1:]):
+                    pair_counts[pair] -= f
+                    touched.add(pair)
+                new = cls._merge_seq(seq, best, next_id)
+                seqs[i] = new
+                for pair in zip(new, new[1:]):
+                    pair_counts[pair] += f
+                    where[pair].add(i)
+                    touched.add(pair)
+                for pair in touched:
+                    if pair != best and pair_counts[pair] > 0:
+                        push(pair)
+            pair_counts.pop(best, None)
+            next_id += 1
+        return cls(merges)
+
+    @staticmethod
+    def _merge_seq(seq, pair, new_id):
+        out, i = [], 0
+        while i < len(seq):
+            if i + 1 < len(seq) and (seq[i], seq[i + 1]) == pair:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(seq[i])
+                i += 1
+        return tuple(out)
+
+    # -- encode / decode ---------------------------------------------------
+
+    def _encode_chunk(self, chunk: str):
+        cached = self._chunk_cache.get(chunk)
+        if cached is not None:
+            return cached
+        if len(self._chunk_cache) >= 65536:
+            # Bound the memo for high-cardinality streams (IDs, numbers):
+            # natural-text hot chunks repopulate almost immediately.
+            self._chunk_cache.clear()
+        seq = tuple(chunk.encode("utf-8"))
+        while len(seq) > 1:
+            # Lowest-rank (earliest-trained) applicable merge first — the
+            # canonical BPE application order.
+            ranked = [
+                (self._ranks[p], p)
+                for p in set(zip(seq, seq[1:]))
+                if p in self._ranks
+            ]
+            if not ranked:
+                break
+            rank, pair = min(ranked)
+            seq = self._merge_seq(seq, pair, 256 + rank)
+        self._chunk_cache[chunk] = seq
+        return seq
+
+    def encode(self, text: str) -> np.ndarray:
+        import re
+
+        ids = []
+        for chunk in re.findall(r"\S+|\s+", text):
+            ids.extend(self._encode_chunk(chunk))
+        return np.asarray(ids, np.int32)
+
+    def decode(self, tokens) -> str:
+        data = b"".join(self.vocab[int(t)] for t in tokens)
+        return data.decode("utf-8", errors="replace")
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        import json
+
+        with open(path, "w") as f:
+            json.dump({"merges": [list(m) for m in self.merges]}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        import json
+
+        with open(path) as f:
+            return cls(json.load(f)["merges"])
 
 
 class TokenDataset:

@@ -1,0 +1,40 @@
+"""Byte-level BPE tokenizer: lossless round-trip, compression, persistence."""
+
+import numpy as np
+
+from rocket_tpu.data.text import BPETokenizer, synthetic_corpus
+
+
+def test_bpe_roundtrip_and_compression():
+    text = synthetic_corpus(num_chars=20_000)
+    tok = BPETokenizer.train(text, vocab_size=512)
+    assert tok.vocab_size <= 512
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text  # lossless
+    # Merges compress vs raw bytes.
+    assert len(ids) < len(text.encode("utf-8")) * 0.8
+    assert ids.dtype == np.int32 and int(ids.max()) < tok.vocab_size
+
+
+def test_bpe_handles_unseen_bytes_and_unicode():
+    tok = BPETokenizer.train("aaab aab ab  ab", vocab_size=260)
+    s = "zzz é世 ab"  # bytes never seen in training
+    assert tok.decode(tok.encode(s)) == s
+
+
+def test_bpe_save_load(tmp_path):
+    text = synthetic_corpus(num_chars=5_000)
+    tok = BPETokenizer.train(text, vocab_size=300)
+    path = str(tmp_path / "bpe.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    s = text[:500]
+    np.testing.assert_array_equal(tok.encode(s), tok2.encode(s))
+    assert tok2.vocab_size == tok.vocab_size
+
+
+def test_bpe_vocab_size_floor():
+    import pytest
+
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train("abc", vocab_size=100)
