@@ -152,16 +152,28 @@ class Module(Dispatcher):
         remat: bool = False,
         param_sharding: Optional[Callable] = None,
         return_outputs: str = "eval",
+        ema_decay: Optional[float] = None,
+        use_ema: bool = False,
         statefull: bool = False,
         priority: int = 1000,
         runtime=None,
     ) -> None:
+        """``ema_decay``: maintain an exponential moving average of the
+        params in the compiled step (``state["ema_params"]``, updated on the
+        sync boundary, checkpointed with the model). ``use_ema``: this
+        (eval) module forwards with the EMA params instead of the raw ones —
+        requires a train module with ``ema_decay`` sharing the same model.
+        """
+        if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"Module: ema_decay must be in (0, 1), got {ema_decay}")
         super().__init__(capsules, statefull=statefull, priority=priority, runtime=runtime)
         self._model = model
         self._compute_dtype = compute_dtype
         self._remat = remat
         self._param_sharding = param_sharding
         self._return_outputs = return_outputs
+        self._ema_decay = ema_decay
+        self._use_ema = use_ema
         self._prepared: Optional[PreparedModule] = None
         self._train_step = None
         self._eval_step = None
@@ -235,9 +247,24 @@ class Module(Dispatcher):
                     # so the Loss capsule never issues eager device ops.
                     prepared.state["loss_acc"] = jnp.zeros((), jnp.float32)
             self._lr_fn = lr if callable(lr) else (lambda step: jnp.asarray(lr))
+            if self._ema_decay is not None and "ema_params" not in prepared.state:
+                # EMA shadow starts as a REAL copy of the params (aliased
+                # leaves would be donated twice by the step); lives in the
+                # donated state so it updates in-step and checkpoints with
+                # the model.
+                prepared.state["ema_params"] = jax.tree.map(
+                    jnp.copy, prepared.state["params"]
+                )
             self._build_train_step(objective, tx, report_grad_norm=report_grad_norm)
         elif objective is not None:
             raise RuntimeError("Module: a Loss child requires an Optimizer child.")
+        elif self._ema_decay is not None:
+            # ema_decay on a module with no update rule would silently never
+            # create or advance the shadow (likely confusion with use_ema).
+            raise RuntimeError(
+                "Module: ema_decay requires an Optimizer child (use "
+                "use_ema=True on the eval module to READ the shadow)."
+            )
 
         # Lay the state out on the mesh: replicated by default, or per the
         # param_sharding rule (tensor parallel / fsdp). Placement happens
@@ -297,7 +324,7 @@ class Module(Dispatcher):
         out = {
             key: jax.device_put(value, runtime.replicated)
             for key, value in state.items()
-            if key not in ("params", "grad_accum", "opt_state")
+            if key not in ("params", "grad_accum", "opt_state", "ema_params")
         }
         out["params"] = jax.tree_util.tree_map_with_path(place, state["params"])
         if "opt_state" in state:
@@ -308,6 +335,10 @@ class Module(Dispatcher):
             # Accumulator mirrors the param layout.
             out["grad_accum"] = jax.tree_util.tree_map_with_path(
                 place, state["grad_accum"]
+            )
+        if "ema_params" in state:
+            out["ema_params"] = jax.tree_util.tree_map_with_path(
+                place, state["ema_params"]
             )
         return out
 
@@ -357,6 +388,13 @@ class Module(Dispatcher):
         forward = self._forward()
         lr_fn = self._lr_fn
         return_out = self._return_outputs == "always"
+        ema_decay = self._ema_decay
+
+        def ema_update(ema, params):
+            # ema += (1-d) * (params - ema) — one fused pass per leaf.
+            return jax.tree.map(
+                lambda e, p: e + (1.0 - ema_decay) * (p - e), ema, params
+            )
 
         def train_step(state, batch):
             rng = jax.random.fold_in(
@@ -385,6 +423,10 @@ class Module(Dispatcher):
                 new_state["params"] = optax.apply_updates(state["params"], updates)
                 new_state["opt_state"] = opt_state
                 opt_step = state["step"]
+                if ema_decay is not None:
+                    new_state["ema_params"] = ema_update(
+                        state["ema_params"], new_state["params"]
+                    )
             else:
                 # The accumulation phase is DERIVED from the step counter —
                 # host and device compute the same boundary from the same
@@ -395,7 +437,7 @@ class Module(Dispatcher):
                 opt_step = state["step"] // accum
 
                 def apply_update(operand):
-                    acc, params, opt_state = operand
+                    acc, params, opt_state, ema = operand
                     mean_grads = jax.tree.map(lambda g: g / accum, acc)
                     # The pre-clip norm of what the clip actually acts on
                     # (the window's mean grads) — NOT the microbatch grads.
@@ -406,21 +448,28 @@ class Module(Dispatcher):
                     )
                     updates, opt_state = tx.update(mean_grads, opt_state, params)
                     params = optax.apply_updates(params, updates)
-                    return _tree_zeros_like(acc), params, opt_state, gn
+                    if ema_decay is not None:
+                        ema = ema_update(ema, params)
+                    return _tree_zeros_like(acc), params, opt_state, ema, gn
 
                 def hold(operand):
-                    acc, params, opt_state = operand
-                    return acc, params, opt_state, jnp.zeros((), jnp.float32)
+                    acc, params, opt_state, ema = operand
+                    return acc, params, opt_state, ema, jnp.zeros((), jnp.float32)
 
-                acc, params, opt_state, accum_grad_norm = jax.lax.cond(
+                # The EMA rides the cond operands even when off (empty dict)
+                # so both branches share one signature.
+                ema_in = state["ema_params"] if ema_decay is not None else {}
+                acc, params, opt_state, ema_out, accum_grad_norm = jax.lax.cond(
                     is_boundary,
                     apply_update,
                     hold,
-                    (acc, state["params"], state["opt_state"]),
+                    (acc, state["params"], state["opt_state"], ema_in),
                 )
                 new_state["grad_accum"] = acc
                 new_state["params"] = params
                 new_state["opt_state"] = opt_state
+                if ema_decay is not None:
+                    new_state["ema_params"] = ema_out
 
             if accum == 1:
                 loss_window = loss
@@ -491,7 +540,19 @@ class Module(Dispatcher):
             if outputs is not None:
                 attrs.batch = _merge_batch(outputs, static)
         else:
-            out = self._eval_step(state["params"], state["model_state"], dynamic)
+            if self._use_ema:
+                # Checked here, not at setup: tree order must not matter
+                # (the train module may legitimately set up after this one).
+                if "ema_params" not in state:
+                    raise RuntimeError(
+                        "Module(use_ema=True): no EMA shadow in the model "
+                        "state — the train Module wrapping this model must "
+                        "set ema_decay."
+                    )
+                eval_params = state["ema_params"]
+            else:
+                eval_params = state["params"]
+            out = self._eval_step(eval_params, state["model_state"], dynamic)
             attrs.batch = _merge_batch(out, static)  # forward replaces batch
             attrs.step_metrics = None
             attrs.sync_gradients = None

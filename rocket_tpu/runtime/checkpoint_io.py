@@ -36,6 +36,7 @@ Write protocol (multihost-safe, caller barriers between phases):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 import threading
@@ -45,6 +46,8 @@ import jax
 import numpy as np
 
 from rocket_tpu.utils.pytree import key_path_str as _path_str
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "atomic_write",
@@ -275,6 +278,13 @@ def load_leaf(path: str, name: str) -> Any:
     return _assemble(meta, _ChunkReader(path), tuple((0, d) for d in shape))
 
 
+#: Leaf-name prefixes that may be absent from older checkpoints: the live
+#: template value is kept (and re-derived by its owner) instead of erroring.
+#: Currently only the EMA shadow — enabling ema_decay mid-run must not make
+#: pre-EMA checkpoints unrestorable.
+OPTIONAL_PREFIXES = ("ema_params/", "ema_params")
+
+
 def load_pytree(path: str, template: Any | None = None) -> Any:
     """Restore a checkpoint directory.
 
@@ -308,7 +318,21 @@ def load_pytree(path: str, template: Any | None = None) -> Any:
     for tpath, tleaf in leaves:
         name = _path_str(tpath)
         meta = index.get(name)
-        if meta is None:
+        if meta is None and name.startswith(OPTIONAL_PREFIXES):
+            # Pre-EMA checkpoint: seed the shadow from the checkpoint's
+            # params leaf (EMA mirrors the params tree path-for-path) so
+            # enabling ema_decay mid-run resumes with EMA = restored params.
+            fallback = "params" + name[len("ema_params"):]
+            meta = index.get(fallback)
+            logger.warning(
+                "checkpoint at %s has no leaf %r — %s", path, name,
+                f"seeding from {fallback!r}" if meta is not None
+                else "keeping the live value",
+            )
+            if meta is None:
+                restored.append(tleaf)
+                continue
+        elif meta is None:
             raise KeyError(
                 f"checkpoint at {path} has no leaf {name!r} "
                 f"(has: {sorted(index)[:8]}...)"

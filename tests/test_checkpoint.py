@@ -388,3 +388,44 @@ def test_overwrite_false_refuses_existing_step(tmp_path):
     os.makedirs(str(tmp_path / "ck" / "2"))  # simulate a pre-existing target
     with pytest.raises(RuntimeError, match="overwrite"):
         ckpt.save(step=2)
+
+
+def test_enabling_ema_mid_run_resumes_from_pre_ema_checkpoint(tmp_path):
+    """A checkpoint saved WITHOUT EMA restores into a tree that now has
+    ema_decay: params restore normally and the EMA shadow seeds from the
+    checkpoint's params (not the fresh init)."""
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    data = make_dataset(n=64)
+    launcher, module = build(runtime, model, data, str(tmp_path / "ck"),
+                             num_epochs=1, save_every=2)
+    launcher.launch()  # saves step 2 without EMA
+
+    runtime2 = Runtime(mesh_shape={"data": 8}, seed=1, project_dir=str(tmp_path))
+    model2 = MLP(in_features=8, num_classes=4, hidden=(16,))
+    module2 = rt.Module(
+        model2,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+        ema_decay=0.99,
+    )
+    tree2 = rt.Launcher(
+        [
+            rt.Looper(
+                [
+                    rt.Dataset(data, batch_size=32),
+                    module2,
+                    rt.Checkpointer(output_dir=str(tmp_path / "ck"),
+                                    resume_from=str(tmp_path / "ck" / "2")),
+                ],
+                tag="train",
+            )
+        ],
+        num_epochs=1, statefull=True, runtime=runtime2,
+    )
+    tree2.setup(rt.Attributes())
+    # EMA seeded from the RESTORED params, not the fresh (seed=1) init.
+    import jax
+
+    for e, p in zip(jax.tree.leaves(module2.state["ema_params"]),
+                    jax.tree.leaves(module2.state["params"])):
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(p))

@@ -318,3 +318,129 @@ def test_gradient_clipping_bounds_update(tmp_path, accum):
     assert max(grad_norms) > 1e-3, grad_norms
     if accum == 2:
         assert grad_norms[0] == 0.0, grad_norms
+
+
+@pytest.mark.parametrize("accum", [1, 2])
+def test_ema_tracks_params_and_eval_uses_it(tmp_path, accum):
+    """state["ema_params"] follows the EMA recurrence at sync boundaries,
+    and an eval Module with use_ema forwards with the shadow params."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        gradient_accumulation_steps=accum,
+    )
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    data = make_dataset(n=128 * accum)
+    decay = 0.5  # aggressive so the shadow visibly lags
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy), rt.Optimizer(optim.sgd(), learning_rate=0.5)],
+        ema_decay=decay,
+    )
+    snaps = []
+
+    class Spy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+
+        def launch(self, attrs=None):
+            if attrs.mode == "train" and attrs.sync_gradients:
+                snaps.append({
+                    "params": jax.tree.map(lambda x: np.asarray(x), module.state["params"]),
+                    "ema": jax.tree.map(lambda x: np.asarray(x), module.state["ema_params"]),
+                })
+
+    eval_batches = []
+
+    class EvalSpy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+
+        def launch(self, attrs=None):
+            if attrs.mode == "eval" and attrs.batch is not None:
+                eval_batches.append(
+                    np.asarray(attrs.batch["logits"], np.float32)
+                )
+
+    eval_module = rt.Module(model, use_ema=True)
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [rt.Dataset(data, batch_size=64), module, Spy()],
+                tag="train", progress=False,
+            ),
+            rt.Looper(
+                [rt.Dataset(data[:64], batch_size=64), eval_module, EvalSpy()],
+                tag="val", grad_enabled=False, progress=False,
+            ),
+        ],
+        num_epochs=1,
+        runtime=runtime,
+    )
+    launcher.launch()
+    assert len(snaps) == 2  # two optimizer boundaries either way
+    # Boundary 2 recurrence: ema2 = ema1 + (1-d)(params2 - ema1).
+    expect = jax.tree.map(
+        lambda e1, p2: e1 + (1 - decay) * (p2 - e1),
+        snaps[0]["ema"], snaps[1]["params"],
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        expect, snaps[1]["ema"],
+    )
+    # The shadow genuinely lags the raw params.
+    gap = max(
+        float(np.max(np.abs(e - p)))
+        for e, p in zip(
+            jax.tree.leaves(snaps[1]["ema"]), jax.tree.leaves(snaps[1]["params"])
+        )
+    )
+    assert gap > 1e-4, gap
+    # The eval forward genuinely used the EMA params: its logits match a
+    # manual forward with the final shadow, not with the raw params.
+    first_image = data[0]["image"]
+    eval_logits = eval_batches[0][0]
+
+    state_template = model.init(jax.random.key(0))["state"]
+
+    def forward_with(params):
+        out, _ = model.apply(
+            {"params": jax.tree.map(jnp.asarray, params), "state": state_template},
+            {"image": jnp.asarray(first_image)[None]},
+            mode="eval",
+        )
+        return np.asarray(out["logits"][0], np.float32)
+
+    np.testing.assert_allclose(
+        eval_logits, forward_with(snaps[-1]["ema"]), rtol=1e-4, atol=1e-5
+    )
+    raw = forward_with(snaps[-1]["params"])
+    assert np.max(np.abs(eval_logits - raw)) > 1e-4  # and NOT the raw params
+
+
+def test_use_ema_without_train_ema_errors(tmp_path):
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    eval_module = rt.Module(model, use_ema=True, runtime=runtime)
+    eval_module.setup()  # order-insensitive: the check happens at launch
+    attrs = rt.Attributes()
+    attrs.mode = "eval"
+    attrs.batch = {"image": np.zeros((8, 8), np.float32)}
+    with pytest.raises(RuntimeError, match="use_ema"):
+        eval_module.launch(attrs)
+
+
+def test_ema_decay_requires_optimizer(tmp_path):
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    module = rt.Module(model, ema_decay=0.99, runtime=runtime)
+    with pytest.raises(RuntimeError, match="ema_decay requires"):
+        module.setup()
