@@ -154,6 +154,7 @@ class Module(Dispatcher):
         return_outputs: str = "eval",
         ema_decay: Optional[float] = None,
         use_ema: bool = False,
+        batch_transform: Optional[Callable] = None,
         statefull: bool = False,
         priority: int = 1000,
         runtime=None,
@@ -163,6 +164,9 @@ class Module(Dispatcher):
         sync boundary, checkpointed with the model). ``use_ema``: this
         (eval) module forwards with the EMA params instead of the raw ones —
         requires a train module with ``ema_decay`` sharing the same model.
+        ``batch_transform``: pure ``fn(batch_dict, key) -> batch_dict``
+        compiled into the TRAIN step before the forward (on-device data
+        augmentation — see ``rocket_tpu.data.augment``); eval is untouched.
         """
         if ema_decay is not None and not 0.0 < ema_decay < 1.0:
             raise ValueError(f"Module: ema_decay must be in (0, 1), got {ema_decay}")
@@ -174,6 +178,7 @@ class Module(Dispatcher):
         self._return_outputs = return_outputs
         self._ema_decay = ema_decay
         self._use_ema = use_ema
+        self._batch_transform = batch_transform
         self._prepared: Optional[PreparedModule] = None
         self._train_step = None
         self._eval_step = None
@@ -264,6 +269,12 @@ class Module(Dispatcher):
             raise RuntimeError(
                 "Module: ema_decay requires an Optimizer child (use "
                 "use_ema=True on the eval module to READ the shadow)."
+            )
+        elif self._batch_transform is not None:
+            raise RuntimeError(
+                "Module: batch_transform compiles into the TRAIN step and "
+                "requires Loss + Optimizer children (eval is never "
+                "transformed)."
             )
 
         # Lay the state out on the mesh: replicated by default, or per the
@@ -389,6 +400,7 @@ class Module(Dispatcher):
         lr_fn = self._lr_fn
         return_out = self._return_outputs == "always"
         ema_decay = self._ema_decay
+        batch_transform = self._batch_transform
 
         def ema_update(ema, params):
             # ema += (1-d) * (params - ema) — one fused pass per leaf.
@@ -400,6 +412,13 @@ class Module(Dispatcher):
             rng = jax.random.fold_in(
                 jax.random.wrap_key_data(state["base_key"]), state["step"]
             )
+            if batch_transform is not None:
+                # On-device augmentation, once per step (outside any remat),
+                # on the raw batch before the compute-dtype cast. Salted key
+                # domain disjoint from the forward's dropout keys.
+                batch = batch_transform(
+                    dict(batch), jax.random.fold_in(rng, 0xA9517)
+                )
 
             def loss_fn(params):
                 out, mstate = forward(

@@ -1,0 +1,90 @@
+"""In-step image augmentation — pure jittable ops, composed into the step.
+
+The reference delegates augmentation to torchvision transforms running in
+host dataloader workers (`/root/reference/rocket/core/dataset.py:52-57`
+wraps a torch DataLoader). The TPU-first design runs augmentation ON DEVICE
+inside the compiled train step (``Module(batch_transform=...)``): the host
+pipeline ships raw samples once (device-cacheable), and each step augments
+with its own PRNG fold — no per-epoch host CPU cost, no H2D amplification.
+
+All ops take NHWC image batches and a PRNG key; randomness is per-sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["random_flip", "random_crop", "cutout", "image_augment"]
+
+
+def random_flip(key, images):
+    """Horizontal flip, p=0.5 independently per sample. (B, H, W, C)."""
+    flip = jax.random.bernoulli(key, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def random_crop(key, images, padding: int = 4):
+    """Reflect-pad by ``padding`` then crop back at a random per-sample
+    offset — the standard CIFAR shift augmentation."""
+    b, h, w, c = images.shape
+    padded = jnp.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="reflect",
+    )
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (b,), 0, 2 * padding + 1)
+    ox = jax.random.randint(kx, (b,), 0, 2 * padding + 1)
+
+    def crop(img, y, x):
+        return jax.lax.dynamic_slice(img, (y, x, 0), (h, w, c))
+
+    return jax.vmap(crop)(padded, oy, ox)
+
+
+def cutout(key, images, size: int = 8):
+    """Zero a ``size`` x ``size`` square at a random per-sample center."""
+    b, h, w, _ = images.shape
+    ky, kx = jax.random.split(key)
+    cy = jax.random.randint(ky, (b, 1), 0, h)
+    cx = jax.random.randint(kx, (b, 1), 0, w)
+    # Asymmetric [c - size//2, c + size//2) window — exactly ``size`` wide
+    # for every parity (a |d| < k band is only odd-width).
+    dy = jnp.arange(h)[None, :] - (cy - size // 2)  # (B, H)
+    dx = jnp.arange(w)[None, :] - (cx - size // 2)  # (B, W)
+    rows = (dy >= 0) & (dy < size)
+    cols = (dx >= 0) & (dx < size)
+    hole = rows[:, :, None] & cols[:, None, :]                     # (B, H, W)
+    return jnp.where(hole[..., None], 0.0, images).astype(images.dtype)
+
+
+def image_augment(
+    *,
+    crop_padding: int = 4,
+    flip: bool = True,
+    cutout_size: int = 0,
+    key_name: str = "image",
+):
+    """Build a ``Module(batch_transform=...)`` fn composing the stock ops.
+
+    The transform receives (batch_dict, per-step PRNG key) inside the
+    compiled train step and must stay pure; keys fold per-op so adding an
+    op never reshuffles the others' randomness.
+    """
+
+    def transform(batch, key):
+        images = batch[key_name]
+        if crop_padding:
+            images = random_crop(
+                jax.random.fold_in(key, 1), images, crop_padding
+            )
+        if flip:
+            images = random_flip(jax.random.fold_in(key, 2), images)
+        if cutout_size:
+            images = cutout(jax.random.fold_in(key, 3), images, cutout_size)
+        out = dict(batch)
+        out[key_name] = images
+        return out
+
+    return transform

@@ -315,6 +315,7 @@ def load_pytree(path: str, template: Any | None = None) -> Any:
 
     leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     restored = []
+    warned_optional = False
     for tpath, tleaf in leaves:
         name = _path_str(tpath)
         meta = index.get(name)
@@ -324,11 +325,14 @@ def load_pytree(path: str, template: Any | None = None) -> Any:
             # enabling ema_decay mid-run resumes with EMA = restored params.
             fallback = "params" + name[len("ema_params"):]
             meta = index.get(fallback)
-            logger.warning(
-                "checkpoint at %s has no leaf %r — %s", path, name,
-                f"seeding from {fallback!r}" if meta is not None
-                else "keeping the live value",
-            )
+            if not warned_optional:
+                warned_optional = True
+                logger.warning(
+                    "checkpoint at %s has no 'ema_params/*' leaves "
+                    "(pre-EMA checkpoint?) — %s", path,
+                    "seeding the EMA shadow from the checkpoint's params"
+                    if meta is not None else "keeping the live values",
+                )
             if meta is None:
                 restored.append(tleaf)
                 continue

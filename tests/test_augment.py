@@ -1,0 +1,97 @@
+"""On-device augmentation ops + the Module batch_transform hook."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.data.augment import cutout, image_augment, random_crop, random_flip
+from rocket_tpu.models.mlp import MLP
+
+
+def test_random_flip_flips_about_half():
+    imgs = jnp.broadcast_to(
+        jnp.arange(8, dtype=jnp.float32)[None, None, :, None], (512, 4, 8, 1)
+    )
+    out = random_flip(jax.random.key(0), imgs)
+    flipped = np.asarray(out[:, 0, 0, 0] == 7.0)
+    assert 0.35 < flipped.mean() < 0.65
+    # A flipped row is the exact reverse, an unflipped row is untouched.
+    np.testing.assert_array_equal(
+        np.asarray(out[flipped][0, 0, :, 0]), np.arange(8)[::-1]
+    )
+
+
+def test_random_crop_preserves_shape_and_content_domain():
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.normal(size=(16, 8, 8, 3)).astype(np.float32))
+    out = random_crop(jax.random.key(1), imgs, padding=2)
+    assert out.shape == imgs.shape
+    # Reflect padding only rearranges values from the source image.
+    assert float(jnp.max(jnp.abs(out))) <= float(jnp.max(jnp.abs(imgs))) + 1e-6
+    # Different keys give different crops.
+    out2 = random_crop(jax.random.key(2), imgs, padding=2)
+    assert float(jnp.max(jnp.abs(out - out2))) > 0
+
+
+def test_cutout_zeroes_a_bounded_hole():
+    imgs = jnp.ones((64, 16, 16, 3))
+    out = cutout(jax.random.key(0), imgs, size=4)
+    zeros_per_img = np.asarray((out == 0).sum(axis=(1, 2, 3)))
+    assert (zeros_per_img > 0).all()
+    assert (zeros_per_img <= 4 * 4 * 3).all()
+    # Interior holes (not clipped by the border) are exactly size x size.
+    assert (zeros_per_img == 4 * 4 * 3).any()
+
+
+def test_image_augment_in_train_step(tmp_path):
+    """batch_transform compiles into the train step: training runs on the
+    8-device mesh and per-step randomness differs step to step."""
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path))
+    rng = np.random.default_rng(0)
+    data = [
+        {"image": rng.normal(size=(8, 8, 1)).astype(np.float32),
+         "label": np.int32(rng.integers(0, 4))}
+        for _ in range(128)
+    ]
+    import optax
+
+    def objective(b):
+        flat = b["image"].reshape(b["image"].shape[0], -1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            b["logits"], b["label"]
+        ).mean() + 0.0 * flat.sum()
+
+    class FlatMLP(MLP):
+        def apply(self, variables, batch, *, mode="train", rng=None):
+            flat = dict(batch)
+            flat["image"] = batch["image"].reshape(batch["image"].shape[0], -1)
+            return super().apply(variables, flat, mode=mode, rng=rng)
+
+    model = FlatMLP(in_features=64, num_classes=4, hidden=(16,))
+    seen = []
+
+    class BatchSpy(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+
+        def launch(self, attrs=None):
+            if attrs.mode == "train":
+                seen.append(True)
+
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(objective), rt.Optimizer(optim.sgd(), learning_rate=0.1)],
+        batch_transform=image_augment(crop_padding=2, flip=True, cutout_size=2),
+    )
+    rt.Launcher(
+        [rt.Looper([rt.Dataset(data, batch_size=32), module, BatchSpy()],
+                   tag="train", progress=False)],
+        num_epochs=1,
+        runtime=runtime,
+    ).launch()
+    assert len(seen) == 4  # trained through the augmented step
