@@ -22,6 +22,7 @@ import optax
 import rocket_tpu as rt
 from rocket_tpu import optim
 from rocket_tpu.data.datasets import ArrayDataset
+from rocket_tpu.data.augment import image_augment
 from rocket_tpu.models.resnet import resnet18
 from rocket_tpu.utils.metrics import Accuracy
 
@@ -73,6 +74,10 @@ def main(num_epochs: int = 5, batch_size: int = 512):
                             rt.Optimizer(optim.momentum(beta=0.9)),
                             rt.Scheduler(optim.cosine_lr(0.2, decay_steps=steps)),
                         ],
+                        # On-device augmentation: the host ships raw samples
+                        # once (device-cached); each step crops+flips with
+                        # its own PRNG fold inside the compiled step.
+                        batch_transform=image_augment(crop_padding=4, flip=True),
                     ),
                     rt.Checkpointer(output_dir="checkpoints/cifar", save_every=200,
                                     keep_last=2),
