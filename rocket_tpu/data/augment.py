@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["random_flip", "random_crop", "cutout", "image_augment"]
+__all__ = ["random_flip", "random_crop", "cutout", "image_augment", "mixup", "soft_cross_entropy"]
 
 
 def random_flip(key, images):
@@ -88,3 +88,55 @@ def image_augment(
         return out
 
     return transform
+
+
+def mixup(alpha: float = 0.2, num_classes: int = 10,
+          image_key: str = "image", label_key: str = "label"):
+    """Mixup as a ``batch_transform``: convex-combine each sample with a
+    shuffled partner (per-sample lambda ~ Beta(alpha, alpha)) and replace
+    the integer labels with the matching soft distribution — train with
+    :func:`soft_cross_entropy`."""
+
+    def transform(batch, key):
+        images, labels = batch[image_key], batch[label_key]
+        b = images.shape[0]
+        k_lam, k_perm = jax.random.split(key)
+        lam = jax.random.beta(k_lam, alpha, alpha, (b,))
+        perm = jax.random.permutation(k_perm, b)
+        lam_img = lam.reshape((b,) + (1,) * (images.ndim - 1))
+        mixed = lam_img * images + (1.0 - lam_img) * images[perm]
+        # Out-of-range labels would one-hot to all-zero rows and silently
+        # under-weight those samples; clamp-and-compare costs nothing and
+        # poisons the loss to NaN instead, which training monitors catch.
+        in_range = (labels >= 0) & (labels < num_classes)
+        one_hot = jnp.where(
+            in_range[:, None],
+            jax.nn.one_hot(labels, num_classes),
+            jnp.nan,
+        )
+        soft = lam[:, None] * one_hot + (1.0 - lam[:, None]) * one_hot[perm]
+        out = dict(batch)
+        out[image_key] = mixed.astype(images.dtype)
+        out[label_key] = soft
+        return out
+
+    return transform
+
+
+def soft_cross_entropy(logits_key: str = "logits", label_key: str = "label"):
+    """Objective for soft (e.g. mixup) labels. Integer labels are also
+    accepted — covering un-mixed train batches (e.g. the same objective
+    reused across configs with mixup toggled off)."""
+    import optax
+
+    def objective(batch):
+        logits, labels = batch[logits_key], batch[label_key]
+        if labels.ndim == logits.ndim:
+            return optax.softmax_cross_entropy(
+                logits.astype(jnp.float32), labels
+            ).mean()
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+
+    return objective

@@ -95,3 +95,42 @@ def test_image_augment_in_train_step(tmp_path):
         runtime=runtime,
     ).launch()
     assert len(seen) == 4  # trained through the augmented step
+
+
+def test_mixup_convexity_and_soft_labels():
+    from rocket_tpu.data.augment import mixup, soft_cross_entropy
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(32, 4, 4, 1)).astype(np.float32)),
+        "label": jnp.asarray(rng.integers(0, 10, size=32).astype(np.int32)),
+    }
+    out = mixup(alpha=0.4, num_classes=10)(dict(batch), jax.random.key(0))
+    # Soft labels: valid distributions with at most two support points.
+    soft = np.asarray(out["label"])
+    np.testing.assert_allclose(soft.sum(-1), 1.0, rtol=1e-5)
+    assert ((soft > 1e-6).sum(-1) <= 2).all()
+    # Images stay inside the convex hull of the originals.
+    lo = float(batch["image"].min()) - 1e-5
+    hi = float(batch["image"].max()) + 1e-5
+    assert lo <= float(out["image"].min()) and float(out["image"].max()) <= hi
+
+    # The objective handles both soft (train) and integer (eval) labels.
+    obj = soft_cross_entropy()
+    logits = jnp.asarray(rng.normal(size=(32, 10)).astype(np.float32))
+    soft_loss = float(obj({"logits": logits, "label": out["label"]}))
+    int_loss = float(obj({"logits": logits, "label": batch["label"]}))
+    assert np.isfinite(soft_loss) and np.isfinite(int_loss)
+
+
+def test_mixup_out_of_range_labels_poison_loss():
+    """Labels >= num_classes must not silently under-weight: the soft
+    targets go NaN so the loss is visibly wrong, not quietly degraded."""
+    from rocket_tpu.data.augment import mixup
+
+    batch = {
+        "image": jnp.ones((4, 2, 2, 1)),
+        "label": jnp.asarray([0, 1, 2, 99], jnp.int32),  # 99 out of range
+    }
+    out = mixup(alpha=0.2, num_classes=10)(batch, jax.random.key(0))
+    assert bool(jnp.isnan(out["label"]).any())
