@@ -35,6 +35,7 @@ no numbers at all, see BASELINE.md), i.e. the round-over-round speedup.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -377,14 +378,39 @@ def main():
     parser.add_argument(
         "--config", default="all", choices=["all", *BENCHES.keys()]
     )
+    parser.add_argument(
+        "--budget-s", type=float, default=None,
+        help="soft wall-clock budget: once exceeded, remaining configs are "
+             "skipped so the JSON line always reaches stdout "
+             "(default: $ROCKET_BENCH_BUDGET_S or 1200)",
+    )
     args = parser.parse_args()
+    if args.budget_s is None:
+        try:
+            args.budget_s = float(os.environ.get("ROCKET_BENCH_BUDGET_S", 1200))
+        except ValueError:
+            log("bench: bad ROCKET_BENCH_BUDGET_S — using 1200s")
+            args.budget_s = 1200.0
     _require_live_backend(
         METRIC_NAMES["gpt2" if args.config == "all" else args.config]
     )
 
     names = list(BENCHES) if args.config == "all" else [args.config]
     results = {}
+    start = time.time()
     for name in names:
+        elapsed = time.time() - start
+        have_success = any("error" not in r for r in results.values())
+        if have_success and elapsed > args.budget_s:
+            # At least one metric is in hand (gpt2 runs first) — better to
+            # emit the JSON line with some configs skipped than to be
+            # killed by an outer timeout with NOTHING on stdout.
+            log(f"bench: {name} skipped (elapsed {elapsed:.0f}s > "
+                f"budget {args.budget_s:.0f}s)")
+            results[name] = {
+                "metric": METRIC_NAMES[name], "error": "skipped: time budget"
+            }
+            continue
         log(f"bench: {name} ...")
         t0 = time.time()
         try:
@@ -392,7 +418,7 @@ def main():
             log(f"bench: {name} -> {results[name]} ({time.time()-t0:.0f}s)")
         except Exception as exc:  # noqa: BLE001 — record, keep benching
             log(f"bench: {name} FAILED: {exc!r}")
-            results[name] = {"metric": name, "error": str(exc)}
+            results[name] = {"metric": METRIC_NAMES[name], "error": str(exc)}
 
     ok = {n: r for n, r in results.items() if "error" not in r}
     headline = ok.get("gpt2") or next(iter(ok.values()), None) \
