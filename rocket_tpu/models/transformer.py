@@ -102,6 +102,11 @@ class TransformerConfig:
     #: largest single allocation in the step. ``next_token_loss`` consumes
     #: either form. Eval mode always materializes logits (metrics need them).
     loss_chunk: int = 0
+    #: Label smoothing for ``next_token_loss``: the target distribution is
+    #: (1-eps) one-hot + eps uniform. Lives on the CONFIG (not the
+    #: objective) so the fused (loss_chunk) and full-logits paths apply the
+    #: same smoothing — the model threads it to whichever path runs.
+    label_smoothing: float = 0.0
 
     def validate(self) -> None:
         """Config-level knob validation — called by TransformerLM and Block
@@ -113,6 +118,11 @@ class TransformerConfig:
         if self.pos_embedding not in ("learned", "rope"):
             raise ValueError(
                 f"TransformerConfig: unknown pos_embedding {self.pos_embedding!r}"
+            )
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError(
+                f"TransformerConfig: label_smoothing must be in [0, 1), got "
+                f"{self.label_smoothing}"
             )
         if self.num_experts > 0 and self.mlp != "gelu":
             raise ValueError(
@@ -549,6 +559,10 @@ class TransformerLM(Model):
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
         # (pipeline path skips the MoE aux loss — see _apply_pipelined)
         out = dict(batch)
+        if self.config.label_smoothing and mode == "train":
+            # Train-only: eval loss stays plain CE, comparable to
+            # log(perplexity) and to unsmoothed baselines.
+            out["label_smoothing"] = self.config.label_smoothing
         fused = (
             self.config.loss_chunk > 0
             and mode == "train"
@@ -568,7 +582,8 @@ class TransformerLM(Model):
                     return jnp.einsum("bcd,vd->bcv", xc, table.astype(xc.dtype))
 
             out["nll"] = _chunked_next_token_nll(
-                x, tokens, self.config.loss_chunk, proj
+                x, tokens, self.config.loss_chunk, proj,
+                label_smoothing=self.config.label_smoothing,
             )
         elif self.head is not None:
             logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
@@ -587,7 +602,7 @@ class TransformerLM(Model):
         return out, variables["state"]
 
 
-def _chunked_next_token_nll(x, tokens, chunk, proj):
+def _chunked_next_token_nll(x, tokens, chunk, proj, label_smoothing=0.0):
     """Mean next-token NLL without materializing (B, T, V) logits.
 
     Scans ``proj`` (the head projection) + softmax-CE over T-chunks under
@@ -611,6 +626,10 @@ def _chunked_next_token_nll(x, tokens, chunk, proj):
         logits = proj(x_c).astype(jnp.float32)                   # (b,c,V)
         lse = jax.nn.logsumexp(logits, axis=-1)                  # (b,c)
         lab = jnp.take_along_axis(logits, y_c[..., None], axis=-1)[..., 0]
+        if label_smoothing:
+            # Smoothed CE: lse - (1-eps)*label_logit - eps*mean(logits).
+            eps = label_smoothing
+            lab = (1.0 - eps) * lab + eps * jnp.mean(logits, axis=-1)
         return jnp.sum((lse - lab) * m_c)
 
     def body(acc, args):
@@ -635,13 +654,22 @@ def next_token_loss(
 
     def objective(batch):
         if "nll" in batch:
-            loss = batch["nll"]
+            loss = batch["nll"]  # fused path applied any label smoothing
         else:
-            logits = batch[logits_key][:, :-1]
+            logits = batch[logits_key][:, :-1].astype(jnp.float32)
             targets = batch[tokens_key][:, 1:]
             loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), targets
-            ).mean()
+                logits, targets
+            )
+            eps = batch.get("label_smoothing")
+            if eps is not None:
+                # Smoothed target = (1-eps) one-hot + eps uniform:
+                # CE_smooth = (1-eps)*CE + eps*(lse - mean(logits)).
+                lse = jax.nn.logsumexp(logits, axis=-1)
+                loss = (1.0 - eps) * loss + eps * (
+                    lse - jnp.mean(logits, axis=-1)
+                )
+            loss = loss.mean()
         aux = batch["moe_aux_loss"] if "moe_aux_loss" in batch else None
         return loss if aux is None else loss + aux
 

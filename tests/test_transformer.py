@@ -545,3 +545,41 @@ def test_rope_is_relative_under_shift():
     out0 = dot_product_attention(apply_rope(q, 0), apply_rope(k, 0), v)
     out7 = dot_product_attention(apply_rope(q, 7), apply_rope(k, 7), v)
     np.testing.assert_allclose(np.asarray(out0), np.asarray(out7), atol=1e-5)
+
+
+def test_label_smoothing_matches_on_both_loss_paths():
+    """config.label_smoothing gives identical losses on the fused
+    (loss_chunk) and full-logits paths, and matches the optax smoothed CE."""
+    import optax
+
+    cfg = tiny_config()
+    cfg.label_smoothing = 0.1
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    obj = next_token_loss()
+
+    cfg.loss_chunk = 0
+    out_full, _ = model.apply(variables, batch, mode="train")
+    full = float(obj(out_full))
+    cfg.loss_chunk = 8
+    out_fused, _ = model.apply(variables, batch, mode="train")
+    fused = float(obj(out_fused))
+    np.testing.assert_allclose(fused, full, rtol=1e-5)
+
+    # Reference: optax smooth_labels + soft CE on the same logits.
+    logits = out_full["logits"][:, :-1].astype(jnp.float32)
+    targets = jax.nn.one_hot(tokens[:, 1:], cfg.vocab_size)
+    smoothed = optax.smooth_labels(targets, 0.1)
+    ref = float(optax.softmax_cross_entropy(logits, smoothed).mean())
+    np.testing.assert_allclose(full, ref, rtol=1e-5)
+
+    # Eval stays plain CE (comparable to log-perplexity).
+    out_eval, _ = model.apply(variables, batch, mode="eval")
+    assert "label_smoothing" not in out_eval
+
+    with pytest.raises(ValueError, match="label_smoothing"):
+        bad = tiny_config()
+        bad.label_smoothing = 1.0
+        TransformerLM(bad)
