@@ -23,6 +23,7 @@ __all__ = [
     "momentum",
     "adam",
     "adamw",
+    "lion",
     "step_lr",
     "cosine_lr",
     "warmup_cosine_lr",
@@ -55,6 +56,25 @@ def momentum(beta: float = 0.9, nesterov: bool = False) -> Factory:
 def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Factory:
     def make(learning_rate):
         return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+    return make
+
+
+def lion(
+    b1: float = 0.9,
+    b2: float = 0.99,
+    weight_decay: float = 0.0,
+    mask_1d: bool = True,
+) -> Factory:
+    """Lion (sign-momentum) — typically run at ~3-10x smaller lr and ~3-10x
+    larger weight_decay than AdamW; half the optimizer memory (one moment).
+    Decay masking follows the same ndim >= 2 convention as :func:`adamw`."""
+
+    def make(learning_rate):
+        mask = _decay_mask if mask_1d and weight_decay else None
+        return optax.lion(
+            learning_rate, b1=b1, b2=b2, weight_decay=weight_decay, mask=mask
+        )
 
     return make
 

@@ -35,3 +35,16 @@ def test_adamw_zero_decay_needs_no_mask():
     state = tx.init(params)
     updates, _ = tx.update(jax.tree.map(jnp.zeros_like, params), state, params)
     np.testing.assert_array_equal(np.asarray(updates["b"]), 0.0)
+
+
+def test_lion_trains_and_masks_decay():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    tx = optim.resolve(optim.lion(weight_decay=0.5), 0.1)
+    state = tx.init(params)
+    grads = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    import optax
+
+    updates, _ = tx.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(new["w"][0, 0]) < 1.0     # sign update + decay move w
+    assert float(new["b"][0]) == 1.0       # zero grad + masked decay: untouched
