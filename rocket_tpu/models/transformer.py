@@ -686,6 +686,7 @@ def generate(
     temperature: float = 1.0,
     top_k: int = None,
     top_p: float = None,
+    eos_token_id: int = None,
     use_cache: bool = True,
 ):
     """Autoregressive sampling from a trained LM, as ONE compiled loop.
@@ -704,6 +705,9 @@ def generate(
     PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens;
     ``top_p`` to the smallest set whose (temperature-scaled) probability
     mass reaches p (nucleus sampling) — both filters compose.
+    ``eos_token_id``: once a sequence samples EOS, every later position is
+    forced to EOS (the loop stays a fixed-trip compiled scan; finished
+    sequences just stop changing).
     Per-step sample keys are derived with ``fold_in(key, position)``, so
     both paths produce identical samples for the same key. Returns
     (B, prompt_len + max_new_tokens) int32.
@@ -733,7 +737,9 @@ def generate(
     key = jax.random.key(0) if key is None else key
     run = _generate_fn(
         model, start, total, float(temperature), top_k,
-        None if top_p is None else float(top_p), use_cache,
+        None if top_p is None else float(top_p),
+        None if eos_token_id is None else int(eos_token_id),
+        use_cache,
     )
     return run(variables["params"], buf, key)
 
@@ -759,8 +765,18 @@ def _sample_token(logits, key, i, temperature, top_k, top_p):
     return jax.random.categorical(sub, logits, axis=-1)
 
 
+def _freeze_after_eos(nxt, buf, i, start, eos):
+    """Force EOS for sequences that already GENERATED it (positions
+    [start, i) — EOS inside the prompt doesn't count). ``i`` is a traced
+    loop index, so the window is an arange mask, not a slice."""
+    idx = jnp.arange(buf.shape[1])
+    window = (idx >= start) & (idx < i)
+    done = jnp.any((buf == eos) & window[None, :], axis=1)
+    return jnp.where(done, eos, nxt)
+
+
 @functools.lru_cache(maxsize=32)
-def _generate_fn(model, start, total, temperature, top_k, top_p, use_cache):
+def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache):
     """Jitted generation loop, cached by (model, window, sampling knobs) —
     a fresh closure per generate() call would retrace and recompile the
     whole model every invocation."""
@@ -780,6 +796,8 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, use_cache):
             def body(i, carry):
                 buf, caches, logits = carry
                 nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
+                if eos is not None:
+                    nxt = _freeze_after_eos(nxt, buf, i, start, eos)
                 buf = buf.at[:, i].set(nxt.astype(jnp.int32))
                 tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
                 logits, caches = model.decode_step(params, tok, caches, i)
@@ -803,6 +821,8 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, use_cache):
                 out[model.logits_key], i - 1, axis=1, keepdims=False
             )
             nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
+            if eos is not None:
+                nxt = _freeze_after_eos(nxt, buf, i, start, eos)
             return buf.at[:, i].set(nxt.astype(jnp.int32))
 
         return jax.lax.fori_loop(start, total, body, buf)

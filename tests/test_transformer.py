@@ -583,3 +583,34 @@ def test_label_smoothing_matches_on_both_loss_paths():
         bad = tiny_config()
         bad.label_smoothing = 1.0
         TransformerLM(bad)
+
+
+def test_generate_eos_freezes_finished_sequences():
+    """Once a sequence samples eos_token_id, all its later positions are
+    eos; other sequences keep generating; eos in the PROMPT doesn't count."""
+    from rocket_tpu.models.transformer import generate
+
+    cfg = tiny_config()
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0))
+    eos = 5
+    # Prompt CONTAINS the eos token — must not freeze from position 0.
+    prompt = np.array([[eos, 1, 4, 1], [2, 7, 1, 8]], np.int32)
+    # key(4)/temperature=1.5 chosen so row 0 demonstrably samples EOS
+    # mid-generation (searched once, pinned — a vacuous no-EOS run would
+    # fail the hits assertion below).
+    out = np.asarray(generate(
+        model, variables, prompt, 12, key=jax.random.key(4),
+        temperature=1.5, eos_token_id=eos,
+    ))
+    gen0 = out[0, 4:]
+    assert gen0[0] != eos  # prompt EOS did NOT freeze generation
+    hits = np.where(gen0 == eos)[0]
+    assert hits.size and 0 < hits[0] < len(gen0) - 1, gen0
+    np.testing.assert_array_equal(gen0[hits[0]:], eos)  # frozen after EOS
+    # Parity between cache and recompute paths holds with eos freezing too.
+    cached = generate(model, variables, prompt, 8, key=jax.random.key(4),
+                      temperature=0.9, eos_token_id=eos, use_cache=True)
+    full = generate(model, variables, prompt, 8, key=jax.random.key(4),
+                    temperature=0.9, eos_token_id=eos, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
