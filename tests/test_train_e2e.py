@@ -444,3 +444,120 @@ def test_ema_decay_requires_optimizer(tmp_path):
     module = rt.Module(model, ema_decay=0.99, runtime=runtime)
     with pytest.raises(RuntimeError, match="ema_decay requires"):
         module.setup()
+
+
+def test_kitchen_sink_train_save_resume(tmp_path):
+    """Every training feature at once — EMA + clip_norm + grad_norm metric
+    + on-device augmentation + gradient accumulation + scheduler +
+    checkpoint save — then a resume that restores params, EMA shadow and
+    counters, and actually trains on past the restored step."""
+    import jax
+
+    from rocket_tpu.data.augment import image_augment
+    from rocket_tpu.runtime.context import Runtime
+
+    rng = np.random.default_rng(0)
+    data = [
+        {"image": rng.normal(size=(8, 8, 1)).astype(np.float32),
+         "label": np.int32(rng.integers(0, 4))}
+        for _ in range(128)
+    ]
+
+    def objective(b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            b["logits"], b["label"]
+        ).mean()
+
+    def build_sink(runtime, resume_from=None, extra=()):
+        # MLP's trunk starts with Flatten, so NHWC images feed it directly.
+        model = MLP(in_features=64, num_classes=4, hidden=(16,))
+        module = rt.Module(
+            model,
+            capsules=[
+                rt.Loss(objective),
+                rt.Optimizer(optim.adamw(), learning_rate=1e-2, clip_norm=1.0),
+                rt.Scheduler(optim.warmup_cosine_lr(1e-2, 2, 16)),
+            ],
+            ema_decay=0.9,
+            batch_transform=image_augment(crop_padding=1, flip=True),
+        )
+        launcher = rt.Launcher(
+            [
+                rt.Looper(
+                    [
+                        rt.Dataset(data, batch_size=32, shuffle=True),
+                        module,
+                        rt.Checkpointer(output_dir=str(tmp_path / "ck"),
+                                        save_every=2, resume_from=resume_from),
+                        *extra,
+                    ],
+                    tag="train", progress=False,
+                )
+            ],
+            num_epochs=1, statefull=True, runtime=runtime,
+        )
+        return launcher, module
+
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        gradient_accumulation_steps=2,
+    )
+    snaps = {}
+    module_ref = []
+
+    class Snap(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=20)  # after the checkpointer's save
+
+        def launch(self, attrs=None):
+            state = module_ref[0].state
+            # Snapshot the state the mid-epoch step-2 checkpoint captured.
+            if int(np.asarray(state["step"])) == 2:
+                snaps["params"] = jax.tree.map(lambda x: np.asarray(x), state["params"])
+                snaps["ema"] = jax.tree.map(lambda x: np.asarray(x), state["ema_params"])
+
+    launcher, module = build_sink(runtime, extra=(Snap(),))
+    module_ref.append(module)
+    launcher.launch()
+    assert "params" in snaps
+
+    # Resume from the mid-epoch step-2 checkpoint: params AND the EMA
+    # shadow restore exactly (seed=7 ensures a fresh init could not match).
+    runtime2 = Runtime(
+        mesh_shape={"data": 8}, seed=7, project_dir=str(tmp_path),
+        gradient_accumulation_steps=2,
+    )
+    launcher2, module2 = build_sink(
+        runtime2, resume_from=str(tmp_path / "ck" / "2"))
+    launcher2.setup(rt.Attributes())
+    assert int(np.asarray(module2.state["step"])) == 2
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        snaps["params"], module2.state["params"],
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        snaps["ema"], module2.state["ema_params"],
+    )
+
+    # A THIRD tree does the full resumed run end-to-end: fast-forwards the
+    # mid-epoch data stream, trains the remaining steps, tears down clean.
+    runtime3 = Runtime(
+        mesh_shape={"data": 8}, seed=7, project_dir=str(tmp_path),
+        gradient_accumulation_steps=2,
+    )
+    final = {}
+    module3_ref = []
+
+    class Final(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=10)
+
+        def launch(self, attrs=None):
+            final["step"] = int(np.asarray(module3_ref[0].state["step"]))
+
+    launcher3, module3 = build_sink(
+        runtime3, resume_from=str(tmp_path / "ck" / "2"), extra=(Final(),))
+    module3_ref.append(module3)
+    launcher3.launch()
+    assert final["step"] == 4, final  # trained past the restored step
