@@ -26,6 +26,8 @@ __all__ = [
     "lion",
     "step_lr",
     "cosine_lr",
+    "linear_lr",
+    "warmup_stable_decay_lr",
     "warmup_cosine_lr",
     "constant_lr",
     "resolve",
@@ -127,6 +129,36 @@ def step_lr(base_lr: float, step_size: int, gamma: float = 0.1) -> Schedule:
 
 def cosine_lr(base_lr: float, decay_steps: int, alpha: float = 0.0) -> Schedule:
     return optax.cosine_decay_schedule(base_lr, decay_steps, alpha=alpha)
+
+
+def linear_lr(base_lr: float, decay_steps: int, end_lr: float = 0.0) -> Schedule:
+    """Linear ramp from ``base_lr`` to ``end_lr`` over ``decay_steps``."""
+    return optax.linear_schedule(base_lr, end_lr, decay_steps)
+
+
+def warmup_stable_decay_lr(
+    base_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    decay_steps: int,
+    end_lr: float = 0.0,
+) -> Schedule:
+    """WSD: linear warmup -> flat plateau -> linear decay over the last
+    ``decay_steps`` — the trapezoid schedule that lets one run branch into
+    checkpoints of different lengths without re-warming."""
+    if warmup_steps + decay_steps > total_steps:
+        raise ValueError(
+            f"warmup_stable_decay_lr: warmup {warmup_steps} + decay "
+            f"{decay_steps} exceed total {total_steps}"
+        )
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, base_lr, warmup_steps),
+            optax.constant_schedule(base_lr),
+            optax.linear_schedule(base_lr, end_lr, decay_steps),
+        ],
+        boundaries=[warmup_steps, total_steps - decay_steps],
+    )
 
 
 def warmup_cosine_lr(

@@ -48,3 +48,22 @@ def test_lion_trains_and_masks_decay():
     new = optax.apply_updates(params, updates)
     assert float(new["w"][0, 0]) < 1.0     # sign update + decay move w
     assert float(new["b"][0]) == 1.0       # zero grad + masked decay: untouched
+
+
+def test_linear_and_wsd_schedules():
+    lin = optim.linear_lr(1.0, 10)
+    assert float(lin(0)) == 1.0 and abs(float(lin(10))) < 1e-7
+    assert abs(float(lin(5)) - 0.5) < 1e-6
+
+    wsd = optim.warmup_stable_decay_lr(1.0, warmup_steps=10, total_steps=100,
+                                       decay_steps=20)
+    assert float(wsd(0)) == 0.0
+    assert abs(float(wsd(10)) - 1.0) < 1e-6   # warmed up
+    assert abs(float(wsd(50)) - 1.0) < 1e-6   # plateau
+    assert abs(float(wsd(90)) - 0.5) < 1e-6   # mid-decay
+    assert abs(float(wsd(100))) < 1e-6        # done
+
+    import pytest
+
+    with pytest.raises(ValueError, match="exceed total"):
+        optim.warmup_stable_decay_lr(1.0, 60, 100, 60)
