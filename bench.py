@@ -400,11 +400,11 @@ def main():
     start = time.time()
     for name in names:
         elapsed = time.time() - start
-        have_success = any("error" not in r for r in results.values())
-        if have_success and elapsed > args.budget_s:
-            # At least one metric is in hand (gpt2 runs first) — better to
-            # emit the JSON line with some configs skipped than to be
-            # killed by an outer timeout with NOTHING on stdout.
+        if elapsed > args.budget_s:
+            # Over budget: stop starting configs whether or not anything
+            # succeeded — a JSON line with skips/errors beats being killed
+            # by an outer timeout with NOTHING on stdout. (A fast early
+            # failure never trips this: elapsed must exceed the budget.)
             log(f"bench: {name} skipped (elapsed {elapsed:.0f}s > "
                 f"budget {args.budget_s:.0f}s)")
             results[name] = {

@@ -132,9 +132,9 @@ class TransformerConfig:
 
     def norm_cls(self):
         """The configured normalizer class — single source of truth for
-        Block (ln1/ln2) and TransformerLM (ln_f)."""
-        if self.norm not in ("layernorm", "rmsnorm"):
-            raise ValueError(f"TransformerConfig: unknown norm {self.norm!r}")
+        Block (ln1/ln2) and TransformerLM (ln_f). Callers run
+        :meth:`validate` first; unknown values fall through to it."""
+        self.validate()
         return RMSNorm if self.norm == "rmsnorm" else LayerNorm
 
     @staticmethod
@@ -765,14 +765,12 @@ def _sample_token(logits, key, i, temperature, top_k, top_p):
     return jax.random.categorical(sub, logits, axis=-1)
 
 
-def _freeze_after_eos(nxt, buf, i, start, eos):
-    """Force EOS for sequences that already GENERATED it (positions
-    [start, i) — EOS inside the prompt doesn't count). ``i`` is a traced
-    loop index, so the window is an arange mask, not a slice."""
-    idx = jnp.arange(buf.shape[1])
-    window = (idx >= start) & (idx < i)
-    done = jnp.any((buf == eos) & window[None, :], axis=1)
-    return jnp.where(done, eos, nxt)
+def _freeze_after_eos(nxt, done, eos):
+    """Force EOS for sequences whose carried ``done`` flag is set (they
+    GENERATED an EOS on an earlier step — prompt EOS never sets it), and
+    fold this step's token into the flag. O(B) per step."""
+    nxt = jnp.where(done, eos, nxt)
+    return nxt, done | (nxt == eos)
 
 
 @functools.lru_cache(maxsize=32)
@@ -793,18 +791,20 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
                 params, buf[:, :start], caches, 0
             )
 
+            done0 = jnp.zeros((buf.shape[0],), bool)
+
             def body(i, carry):
-                buf, caches, logits = carry
+                buf, caches, logits, done = carry
                 nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
                 if eos is not None:
-                    nxt = _freeze_after_eos(nxt, buf, i, start, eos)
+                    nxt, done = _freeze_after_eos(nxt, done, eos)
                 buf = buf.at[:, i].set(nxt.astype(jnp.int32))
                 tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
                 logits, caches = model.decode_step(params, tok, caches, i)
-                return buf, caches, logits
+                return buf, caches, logits, done
 
-            buf, _, _ = jax.lax.fori_loop(
-                start, total, body, (buf, caches, logits)
+            buf, _, _, _ = jax.lax.fori_loop(
+                start, total, body, (buf, caches, logits, done0)
             )
             return buf
 
@@ -812,7 +812,8 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
 
     @jax.jit
     def run(params, buf, key):
-        def body(i, buf):
+        def body(i, carry):
+            buf, done = carry
             out, _ = model.apply(
                 {"params": params, "state": {}}, {model.tokens_key: buf},
                 mode="eval",
@@ -822,9 +823,11 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
             )
             nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
             if eos is not None:
-                nxt = _freeze_after_eos(nxt, buf, i, start, eos)
-            return buf.at[:, i].set(nxt.astype(jnp.int32))
+                nxt, done = _freeze_after_eos(nxt, done, eos)
+            return buf.at[:, i].set(nxt.astype(jnp.int32)), done
 
-        return jax.lax.fori_loop(start, total, body, buf)
+        done0 = jnp.zeros((buf.shape[0],), bool)
+        buf, _ = jax.lax.fori_loop(start, total, body, (buf, done0))
+        return buf
 
     return run

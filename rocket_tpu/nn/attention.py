@@ -172,12 +172,6 @@ class MultiHeadAttention(Layer):
             )
         if rope and (features // num_heads) % 2 != 0:
             raise ValueError("MultiHeadAttention: rope needs an even head_dim")
-        if rope and impl == "ring":
-            # Under ring the sequence is sharded; local position offsets
-            # would silently rotate with the wrong absolute positions.
-            raise ValueError(
-                "MultiHeadAttention: rope is not supported with impl='ring'"
-            )
         self.rope = rope
         self.rope_base = rope_base
         self.features = features
@@ -225,6 +219,35 @@ class MultiHeadAttention(Layer):
         )
         return q, k, v
 
+    def _ring(self, q, k, v):
+        """Sequence-parallel ring attention: T is sharded over the mesh's
+        seq axis; KV blocks rotate over ICI (parallel/ring_attention).
+        RoPE composes: rotations happen on the GSPMD-global view with
+        global positions before the shard_map entry."""
+        from rocket_tpu.parallel.ring_attention import ring_attention_sharded
+        from rocket_tpu.runtime.context import Runtime
+
+        # The mesh is PINNED on first trace: a later Runtime constructed
+        # in the same process must not silently redirect a retrace of
+        # this model onto a different mesh.
+        mesh = self._ring_mesh
+        if mesh is None:
+            runtime = Runtime.current()
+            if runtime is None or self.seq_axis not in runtime.mesh.shape:
+                raise RuntimeError(
+                    "MultiHeadAttention(impl='ring') needs a live Runtime "
+                    f"whose mesh has a {self.seq_axis!r} axis "
+                    "(e.g. Runtime(mesh_shape={'data': 2, 'seq': 4}))."
+                )
+            mesh = self._ring_mesh = runtime.mesh
+        return ring_attention_sharded(
+            q, k, v,
+            mesh=mesh,
+            seq_axis=self.seq_axis,
+            data_axis="data" if "data" in mesh.shape else None,
+            causal=self.causal,
+        )
+
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
         b, t, _ = x.shape
@@ -238,10 +261,14 @@ class MultiHeadAttention(Layer):
             if self.rope:
                 q = apply_rope(q, 0, self.rope_base)
                 k = apply_rope(k, 0, self.rope_base)
-            use_flash = resolve_impl(self.impl, t, self.head_dim) == "flash"
+            impl = resolve_impl(self.impl, t, self.head_dim)
+            use_flash = impl == "flash"
             if use_flash:
                 from rocket_tpu.ops.flash_attention import flash_attention_qkv
-            if self.num_kv_heads != self.num_heads:
+            if impl == "ring":
+                # rope-only here: GQA+ring is rejected at construction.
+                out = self._ring(q, k, v)
+            elif self.num_kv_heads != self.num_heads:
                 if use_flash:
                     # Training-time GQA rides the flash kernel by repeating
                     # K/V to full heads: GQA doesn't shrink the attention
@@ -280,32 +307,8 @@ class MultiHeadAttention(Layer):
                 jnp.transpose(qkv, (2, 0, 3, 1, 4)), causal=self.causal
             )
         elif impl == "ring":
-            # Sequence-parallel ring attention: T is sharded over the mesh's
-            # seq axis; KV blocks rotate over ICI (parallel/ring_attention).
-            from rocket_tpu.parallel.ring_attention import ring_attention_sharded
-            from rocket_tpu.runtime.context import Runtime
-
-            # The mesh is PINNED on first trace: a later Runtime constructed
-            # in the same process must not silently redirect a retrace of
-            # this model onto a different mesh.
-            mesh = self._ring_mesh
-            if mesh is None:
-                runtime = Runtime.current()
-                if runtime is None or self.seq_axis not in runtime.mesh.shape:
-                    raise RuntimeError(
-                        "MultiHeadAttention(impl='ring') needs a live Runtime "
-                        f"whose mesh has a {self.seq_axis!r} axis "
-                        "(e.g. Runtime(mesh_shape={'data': 2, 'seq': 4}))."
-                    )
-                mesh = self._ring_mesh = runtime.mesh
             q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
-            out = ring_attention_sharded(
-                q, k, v,
-                mesh=mesh,
-                seq_axis=self.seq_axis,
-                data_axis="data" if "data" in mesh.shape else None,
-                causal=self.causal,
-            )
+            out = self._ring(q, k, v)
         else:
             q, k, v = (
                 jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)

@@ -278,11 +278,14 @@ def load_leaf(path: str, name: str) -> Any:
     return _assemble(meta, _ChunkReader(path), tuple((0, d) for d in shape))
 
 
-#: Leaf-name prefixes that may be absent from older checkpoints: the live
-#: template value is kept (and re-derived by its owner) instead of erroring.
-#: Currently only the EMA shadow — enabling ema_decay mid-run must not make
-#: pre-EMA checkpoints unrestorable.
-OPTIONAL_PREFIXES = ("ema_params/", "ema_params")
+#: Leaf names that may be absent from older checkpoints: the EMA shadow —
+#: enabling ema_decay mid-run must not make pre-EMA checkpoints
+#: unrestorable. Matched EXACTLY ("ema_params" or under "ema_params/"), so
+#: an unrelated leaf merely starting with the string still hard-fails.
+
+
+def _is_optional_leaf(name: str) -> bool:
+    return name == "ema_params" or name.startswith("ema_params/")
 
 
 def load_pytree(path: str, template: Any | None = None) -> Any:
@@ -319,7 +322,7 @@ def load_pytree(path: str, template: Any | None = None) -> Any:
     for tpath, tleaf in leaves:
         name = _path_str(tpath)
         meta = index.get(name)
-        if meta is None and name.startswith(OPTIONAL_PREFIXES):
+        if meta is None and _is_optional_leaf(name):
             # Pre-EMA checkpoint: seed the shadow from the checkpoint's
             # params leaf (EMA mirrors the params tree path-for-path) so
             # enabling ema_decay mid-run resumes with EMA = restored params.
