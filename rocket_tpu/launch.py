@@ -1,0 +1,120 @@
+"""Single-node multi-process launcher — the ``accelerate launch`` analogue.
+
+``python -m rocket_tpu.launch -n 4 train.py [args...]`` spawns N copies of
+the script with the coordinator env vars ``Runtime`` reads
+(``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``)
+pre-wired to a localhost coordinator. Each process's output is prefixed
+with its rank; the launcher exits non-zero if any worker does, terminating
+the stragglers.
+
+Multi-NODE launches don't need this helper: run one process per host with
+the same three env vars pointing at host 0 (see docs/distributed.md §3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+__all__ = ["main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int) -> None:
+    for line in proc.stdout:
+        sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
+        sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.launch",
+        description="Run a training script as N coordinated processes on "
+        "this machine.",
+    )
+    parser.add_argument("-n", "--nproc", type=int, required=True,
+                        help="number of processes")
+    parser.add_argument("--coordinator-port", type=int, default=None,
+                        help="default: a free localhost port")
+    parser.add_argument("script", help="python script to run")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER,
+                        help="arguments passed through to the script")
+    args = parser.parse_args(argv)
+    if args.nproc < 1:
+        parser.error("--nproc must be >= 1")
+
+    port = args.coordinator_port or _free_port()
+    procs: list[subprocess.Popen] = []
+    threads = []
+    rc = 0
+    try:
+        # Spawn INSIDE the try: a failed fork at rank k must still tear
+        # down ranks 0..k-1 (they would otherwise hang forever in
+        # distributed init waiting for the missing peers).
+        for rank in range(args.nproc):
+            env = dict(os.environ)
+            env.update(
+                JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                JAX_NUM_PROCESSES=str(args.nproc),
+                JAX_PROCESS_ID=str(rank),
+            )
+            proc = subprocess.Popen(
+                [sys.executable, args.script, *args.script_args],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            procs.append(proc)
+            thread = threading.Thread(
+                target=_stream, args=(proc, rank), daemon=True
+            )
+            thread.start()
+            threads.append(thread)
+
+        # Poll ALL workers: the classic failure mode is one rank dying
+        # while the rest block in a collective waiting for it — a
+        # sequential wait() on rank 0 would hang forever. As soon as any
+        # worker exits non-zero, the stragglers are torn down.
+        import time
+
+        live = set(range(args.nproc))
+        while live:
+            for rank in sorted(live):
+                code = procs[rank].poll()
+                if code is None:
+                    continue
+                live.discard(rank)
+                rc = code or rc
+                if code:
+                    live.clear()  # finally-block terminates the rest
+                    break
+            else:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        rc = 128 + signal.SIGINT
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for thread in threads:
+            thread.join(timeout=2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
