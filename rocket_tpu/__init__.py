@@ -24,6 +24,7 @@ from rocket_tpu.core import (
     Profiler,
     Scheduler,
     Tracker,
+    register_tracker_backend,
 )
 from rocket_tpu.runtime.context import Runtime
 
@@ -47,4 +48,5 @@ __all__ = [
     "Runtime",
     "Scheduler",
     "Tracker",
+    "register_tracker_backend",
 ]

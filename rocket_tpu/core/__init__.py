@@ -14,7 +14,7 @@ from rocket_tpu.core.module import Module
 from rocket_tpu.core.optimizer import Optimizer
 from rocket_tpu.core.profiler import Profiler
 from rocket_tpu.core.scheduler import Scheduler
-from rocket_tpu.core.tracker import Tracker
+from rocket_tpu.core.tracker import Tracker, register_tracker_backend
 
 __all__ = [
     "Attributes",
@@ -33,4 +33,5 @@ __all__ = [
     "Profiler",
     "Scheduler",
     "Tracker",
+    "register_tracker_backend",
 ]

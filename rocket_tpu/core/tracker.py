@@ -30,7 +30,12 @@ import numpy as np
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import PRIORITY_TRACKER, Capsule
 
-__all__ = ["Tracker", "JsonlBackend", "TensorBoardBackend"]
+__all__ = [
+    "Tracker",
+    "JsonlBackend",
+    "TensorBoardBackend",
+    "register_tracker_backend",
+]
 
 
 class JsonlBackend:
@@ -73,10 +78,26 @@ class TensorBoardBackend:
 _BACKENDS = {"jsonl": JsonlBackend, "tensorboard": TensorBoardBackend}
 
 
+def register_tracker_backend(name: str, factory) -> None:
+    """Register a custom tracker backend under ``name`` (the analogue of
+    accelerate's ``log_with`` ecosystem, reference ``tracker.py:30-46``).
+
+    ``factory(project: str, directory: str)`` must return a duck-typed
+    backend: ``log_scalars(dict, step)``, ``log_images(dict, step)`` and
+    ``close()`` (see :class:`JsonlBackend` for the minimal shape). Capsules
+    then select it with ``Tracker(backend=name)``.
+    """
+    _BACKENDS[name] = factory
+
+
 class Tracker(Capsule):
+    """``backend`` may be a registered name ("jsonl", "tensorboard", or a
+    :func:`register_tracker_backend` entry) or a ready duck-typed backend
+    INSTANCE (shared across capsules under the name of its type)."""
+
     def __init__(
         self,
-        backend: str = "jsonl",
+        backend="jsonl",
         project: str = "rocket",
         config: Optional[dict] = None,
         directory: str = "runs",
@@ -85,7 +106,22 @@ class Tracker(Capsule):
         runtime=None,
     ) -> None:
         super().__init__(statefull=statefull, priority=priority, runtime=runtime)
-        self._backend_name = backend
+        if isinstance(backend, str):
+            self._backend_name, self._backend_instance = backend, None
+        else:
+            # Duck-typed instance: registered under its type name so a
+            # second capsule naming that type shares it.
+            missing = [
+                m for m in ("log_scalars", "log_images", "close")
+                if not callable(getattr(backend, m, None))
+            ]
+            if missing:
+                raise RuntimeError(
+                    f"Tracker: backend instance {type(backend).__name__} "
+                    f"lacks {missing}; see JsonlBackend for the contract."
+                )
+            self._backend_name = type(backend).__name__
+            self._backend_instance = backend
         self._project = project
         self._config = config or {}
         self._directory = directory
@@ -100,20 +136,24 @@ class Tracker(Capsule):
         # Registry with lazy init (tracker.py:30-46).
         backend = runtime.get_tracker(self._backend_name)
         if backend is None and runtime.is_main_process:
-            factory = _BACKENDS.get(self._backend_name)
-            if factory is None:
-                raise RuntimeError(
-                    f"Tracker: unknown backend {self._backend_name!r}; "
-                    f"available: {sorted(_BACKENDS)}"
-                )
-            try:
-                backend = factory(self._project, self._directory)
-            except ImportError:
-                self.log_warning(
-                    f"backend {self._backend_name!r} unavailable, "
-                    "falling back to jsonl"
-                )
-                backend = JsonlBackend(self._project, self._directory)
+            if self._backend_instance is not None:
+                backend = self._backend_instance
+            else:
+                factory = _BACKENDS.get(self._backend_name)
+                if factory is None:
+                    raise RuntimeError(
+                        f"Tracker: unknown backend {self._backend_name!r}; "
+                        f"available: {sorted(_BACKENDS)} (register custom "
+                        "ones with register_tracker_backend)"
+                    )
+                try:
+                    backend = factory(self._project, self._directory)
+                except ImportError:
+                    self.log_warning(
+                        f"backend {self._backend_name!r} unavailable, "
+                        "falling back to jsonl"
+                    )
+                    backend = JsonlBackend(self._project, self._directory)
             runtime.init_tracker(self._backend_name, backend)
             if self._config:
                 backend.log_scalars(

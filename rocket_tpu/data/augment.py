@@ -24,14 +24,17 @@ def random_flip(key, images):
     return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
 
 
-def random_crop(key, images, padding: int = 4):
-    """Reflect-pad by ``padding`` then crop back at a random per-sample
-    offset — the standard CIFAR shift augmentation."""
+def random_crop(key, images, padding: int = 4, pad_mode: str = "constant"):
+    """Pad by ``padding`` then crop back at a random per-sample offset —
+    the standard CIFAR shift augmentation. ``pad_mode`` follows
+    ``jnp.pad``: the "constant" (zero) default matches the reference
+    pipeline's torchvision ``RandomCrop(padding=4)``; "reflect" is the
+    common alternative."""
     b, h, w, c = images.shape
     padded = jnp.pad(
         images,
         ((0, 0), (padding, padding), (padding, padding), (0, 0)),
-        mode="reflect",
+        mode=pad_mode,
     )
     ky, kx = jax.random.split(key)
     oy = jax.random.randint(ky, (b,), 0, 2 * padding + 1)
@@ -62,6 +65,7 @@ def cutout(key, images, size: int = 8):
 def image_augment(
     *,
     crop_padding: int = 4,
+    crop_pad_mode: str = "constant",
     flip: bool = True,
     cutout_size: int = 0,
     key_name: str = "image",
@@ -77,7 +81,8 @@ def image_augment(
         images = batch[key_name]
         if crop_padding:
             images = random_crop(
-                jax.random.fold_in(key, 1), images, crop_padding
+                jax.random.fold_in(key, 1), images, crop_padding,
+                pad_mode=crop_pad_mode,
             )
         if flip:
             images = random_flip(jax.random.fold_in(key, 2), images)

@@ -20,14 +20,27 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 __all__ = ["main"]
 
 
 def _free_port() -> int:
+    """A currently-free localhost port.
+
+    Inherently TOCTOU: the probe socket must close before the coordinator
+    (inside the rank-0 worker, whose socket options we don't control) can
+    bind it, so another process may grab the port in between. ``main``
+    compensates by retrying a fast startup failure on a fresh port."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+#: A non-zero exit this early into a run is treated as "coordinator failed
+#: to start" (e.g. the probed port was taken) and retried on a new port.
+_STARTUP_WINDOW_S = 15.0
+_MAX_PORT_RETRIES = 2
 
 
 def _stream(proc: subprocess.Popen, rank: int) -> None:
@@ -53,7 +66,26 @@ def main(argv=None) -> int:
     if args.nproc < 1:
         parser.error("--nproc must be >= 1")
 
-    port = args.coordinator_port or _free_port()
+    for attempt in range(_MAX_PORT_RETRIES + 1):
+        port = args.coordinator_port or _free_port()
+        started = time.monotonic()
+        rc = _run_once(args, port)
+        fast_failure = rc != 0 and time.monotonic() - started < _STARTUP_WINDOW_S
+        if rc == 128 + signal.SIGINT or rc < 0:
+            # User interrupt / signal-killed worker (segfault, OOM kill):
+            # never a coordinator-port race — don't re-run.
+            break
+        if rc == 0 or args.coordinator_port or not fast_failure:
+            break
+        if attempt < _MAX_PORT_RETRIES:
+            sys.stderr.write(
+                f"launch: workers failed within {_STARTUP_WINDOW_S:.0f}s "
+                f"(possible port {port} race) — retrying on a new port\n"
+            )
+    return rc
+
+
+def _run_once(args, port: int) -> int:
     procs: list[subprocess.Popen] = []
     threads = []
     rc = 0
@@ -85,7 +117,6 @@ def main(argv=None) -> int:
         # while the rest block in a collective waiting for it — a
         # sequential wait() on rank 0 would hang forever. As soon as any
         # worker exits non-zero, the stragglers are torn down.
-        import time
 
         live = set(range(args.nproc))
         while live:

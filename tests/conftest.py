@@ -41,8 +41,30 @@ def runtime8(tmp_path):
     )
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run @pytest.mark.slow tests (the full CI tier; the "
+        "default fast tier finishes in a few minutes)",
+    )
+
+
 def pytest_configure(config):
     assert len(jax.devices()) == 8, (
         f"expected 8 virtual CPU devices, got {len(jax.devices())}: "
         f"{jax.devices()}"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (multi-process spawns, big compiles); "
+        "skipped unless --runslow",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

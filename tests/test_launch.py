@@ -1,10 +1,12 @@
 """python -m rocket_tpu.launch: spawns N coordinated processes."""
 
+import pytest
 import os
 import subprocess
 import sys
 
 
+@pytest.mark.slow
 def test_launch_two_processes(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(
@@ -39,6 +41,7 @@ def test_launch_two_processes(tmp_path):
     assert "[rank 0]" in out.stdout and "[rank 1]" in out.stdout
 
 
+@pytest.mark.slow
 def test_launch_propagates_failure(tmp_path):
     script = tmp_path / "bad.py"
     script.write_text("import sys; sys.exit(3)\n")
@@ -49,6 +52,7 @@ def test_launch_propagates_failure(tmp_path):
     assert out.returncode != 0
 
 
+@pytest.mark.slow
 def test_launch_tears_down_stragglers(tmp_path):
     """When one rank dies, the launcher must terminate the survivors and
     exit non-zero rather than hang on a sequential wait."""

@@ -75,6 +75,7 @@ def test_moe_validation():
         MoE(dim=4, hidden=8, num_experts=2, top_k=3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("scan", [False, True])
 def test_moe_lm_trains_expert_parallel(tmp_path, scan):
     """A small MoE LM trains on a ('data', 'expert') mesh with the stacked

@@ -8,6 +8,7 @@ all-rank barrier, per-host striped loading, cross-process training
 collectives, and the sharded (gather-free) checkpoint save from BOTH hosts.
 """
 
+import pytest
 import os
 import socket
 import subprocess
@@ -121,6 +122,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_distributed_train_and_checkpoint(tmp_path):
     port = _free_port()
     procs = []

@@ -1,5 +1,6 @@
 """ResNet: shapes, param counts, batchnorm state updates, e2e training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -14,6 +15,7 @@ def n_params(variables):
     return sum(int(l.size) for l in jax.tree.leaves(variables["params"]))
 
 
+@pytest.mark.slow
 def test_resnet18_cifar_shapes_and_params():
     model = resnet18(num_classes=10, stem="cifar")
     variables = model.init(jax.random.key(0))
@@ -24,6 +26,7 @@ def test_resnet18_cifar_shapes_and_params():
     assert abs(n_params(variables) - 11_173_962) < 120_000, n_params(variables)
 
 
+@pytest.mark.slow
 def test_resnet50_param_count():
     model = resnet50(num_classes=1000)
     variables = model.init(jax.random.key(0))
@@ -46,6 +49,7 @@ def test_batchnorm_state_updates_in_train_only():
     )
 
 
+@pytest.mark.slow
 def test_resnet_trains_on_mesh(runtime8):
     # Tiny images, 8-way data parallel with batchnorm state in the train step.
     rng = np.random.default_rng(0)

@@ -75,3 +75,41 @@ def test_jsonl_backend_writes_records(tmp_path):
     with open(os.path.join(str(tmp_path), "proj.jsonl")) as f:
         record = json.loads(f.read().splitlines()[-1])
     assert record["step"] == 3 and record["loss"] == 0.5
+
+
+def test_register_custom_backend_and_instance(runtime):
+    from rocket_tpu.core.tracker import register_tracker_backend
+
+    # (a) Registered factory, selected by name.
+    made = []
+
+    class CustomBackend(SpyBackend):
+        def __init__(self, project, directory):
+            super().__init__()
+            made.append((project, directory))
+
+    register_tracker_backend("custom_spy", CustomBackend)
+    try:
+        tracker = Tracker(backend="custom_spy", project="p", directory="d",
+                          runtime=runtime)
+        tracker.setup()
+        assert made == [("p", "d")]
+        run_epoch(tracker, [{"scalars": {"x": 1.0}, "sync": True}])
+        assert tracker._backend.scalars[0][1] == {"x": 1.0}
+    finally:
+        from rocket_tpu.core import tracker as tracker_mod
+
+        tracker_mod._BACKENDS.pop("custom_spy", None)
+
+    # (b) Ready duck-typed instance passed directly.
+    spy = SpyBackend()
+    t2 = Tracker(backend=spy, project="p", runtime=runtime)
+    t2.setup()
+    assert t2._backend is spy
+    assert runtime.get_tracker("SpyBackend") is spy
+
+    # (c) Instance missing the contract is rejected up front.
+    import pytest
+
+    with pytest.raises(RuntimeError, match="lacks"):
+        Tracker(backend=object(), runtime=runtime)

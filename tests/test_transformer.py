@@ -40,6 +40,7 @@ def test_param_count_gpt2():
     assert abs(n - 124_439_808) < 1_000_000, n
 
 
+@pytest.mark.slow
 def test_char_lm_learns(runtime8):
     corpus = synthetic_corpus(num_chars=40_000)
     tok = CharTokenizer(corpus)
@@ -189,6 +190,7 @@ def _train_losses(tmp_path, mesh_shape, attention_impl, tag, **config_kw):
     return losses
 
 
+@pytest.mark.slow
 def test_ring_attention_matches_unsharded_training(tmp_path):
     """Same seed, same data: seq sharded over 4 devices (ring) vs one-axis
     data-parallel (xla attention) — losses must agree to fp tolerance."""
@@ -198,6 +200,7 @@ def test_ring_attention_matches_unsharded_training(tmp_path):
     np.testing.assert_allclose(ring, base, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_with_rope_matches_unsharded(tmp_path):
     """RoPE composes with ring: rotations run on the GSPMD-global view with
     global positions, so seq-sharded losses match the unsharded run."""
@@ -238,6 +241,7 @@ def test_scan_layers_matches_looped_forward():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_scan_layers_trains_with_tp_rules(tmp_path):
     """Stacked params + left-padded TP specs: one training epoch on a
     ('data','model') mesh keeps the stacked QKV sharded over 'model'."""
@@ -300,6 +304,7 @@ def test_generate_shapes_determinism_and_range():
         generate(model, variables, prompt, config.max_seq_len)
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_matches_looped_model(tmp_path):
     """GPipe trunk over a ('data','pipe') mesh: logits match the plain
     looped model, and a training epoch runs with pipeline_rules sharding."""
@@ -373,6 +378,7 @@ def test_pipeline_requires_scan_layers():
         model.apply(variables, {"tokens": jnp.zeros((4, 16), jnp.int32)}, mode="eval")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("scan", [False, True])
 def test_cached_generation_matches_recompute(scan):
     """KV-cached decode (O(T) per token) must produce exactly the same
@@ -396,6 +402,7 @@ def test_cached_generation_matches_recompute(scan):
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("tied,scan", [(True, False), (False, False), (True, True), (False, True)])
 def test_fused_loss_chunk_matches_full_logits(tied, scan):
     """loss_chunk (chunked head+CE, no logits materialization) must be a
@@ -443,6 +450,7 @@ def test_fused_loss_chunk_skips_eval_and_ragged():
     assert "logits" in out and "nll" not in out
 
 
+@pytest.mark.slow
 def test_generate_top_p_restricts_to_nucleus():
     """With a peaked distribution and small top_p, sampling must collapse
     to the argmax token; top_p=1.0 must match unfiltered sampling."""
@@ -473,6 +481,7 @@ def test_generate_top_p_restricts_to_nucleus():
     assert nucleus.shape == (2, 12)
 
 
+@pytest.mark.slow
 def test_gqa_lm_trains_and_generates():
     """num_kv_heads < num_heads: forward, grads, and cached-vs-recompute
     generation parity all hold on the grouped attention path."""
@@ -596,6 +605,7 @@ def test_label_smoothing_matches_on_both_loss_paths():
         TransformerLM(bad)
 
 
+@pytest.mark.slow
 def test_generate_eos_freezes_finished_sequences():
     """Once a sequence samples eos_token_id, all its later positions are
     eos; other sequences keep generating; eos in the PROMPT doesn't count."""
