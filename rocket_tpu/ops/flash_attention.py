@@ -51,7 +51,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attention_qkv", "pick_block"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_qkv",
+    "flash_attention_qkv_sharded",
+    "in_manual_axes",
+    "pick_block",
+    "shardable_axes",
+]
 
 _NEG_INF = -1e30
 _LOG2E = math.log2(math.e)
@@ -395,6 +402,96 @@ def flash_attention_qkv(
     if interpret is None:
         interpret = _interpret_default()
     return _flash(qkv, causal, block_q, block_k, interpret)
+
+
+def in_manual_axes(axis_names) -> bool:
+    """True when tracing inside a ``shard_map`` that binds any of
+    ``axis_names`` (e.g. the pipeline-parallel stage body). There the
+    operands are already per-shard local arrays — the kernel must be called
+    directly; nesting another shard_map over the same mesh is an error."""
+    for name in axis_names:
+        try:
+            jax.lax.axis_index(name)  # dead op if bound; DCE'd
+            return True
+        except NameError:
+            continue
+    return False
+
+
+def shardable_axes(mesh, b: int, h: int, batch_axes=("data",),
+                   head_axis: str = "model"):
+    """(batch_axes_tuple | None, head_axis | None) usable by the seam:
+    axes that exist in ``mesh`` with size > 1 and divide the corresponding
+    dim. Shared by the ``resolve_impl`` "auto" gate (which must NOT pick
+    flash when nothing is shardable — a replicated pallas call would
+    all-gather the batch) and the wrapper itself."""
+    baxes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    bsize = 1
+    for a in baxes:
+        bsize *= mesh.shape[a]
+    if not baxes or b % bsize:
+        baxes = None
+    haxis = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    if haxis is not None and h % mesh.shape[haxis]:
+        haxis = None
+    return baxes, haxis
+
+
+def flash_attention_qkv_sharded(
+    qkv: jax.Array,
+    causal: bool = True,
+    *,
+    mesh,
+    batch_axes=("data",),
+    head_axis: str = "model",
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention composed with a multi-device mesh via ``shard_map``.
+
+    Batch and head dims are embarrassingly parallel for attention (each
+    (b, h) pair is an independent softmax), so the kernel runs per-shard
+    with the batch dim split over ``batch_axes`` (data parallel / FSDP) and
+    the head dim over ``head_axis`` (Megatron tensor parallel, where the
+    QKV projection already produced head-sharded activations) — zero
+    communication is added; GSPMD reshards operands only if they arrived in
+    a different layout. The sequence axis stays shard-local: sequence
+    parallelism is ring attention's job (``parallel/ring_attention.py``).
+
+    Mesh axes that don't exist, are trivial (size 1), or don't divide the
+    corresponding dim are simply dropped from the specs (that dim is then
+    replicated over them). The reference composes kernels with DDP for free
+    through torch's prepared module (``/root/reference/rocket/core/
+    module.py:47``); this seam is the TPU-native equivalent for a pallas
+    custom call, which GSPMD would otherwise fully replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:  # jax >= 0.8
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    _, b, h, t, d = qkv.shape
+    baxes, haxis = shardable_axes(mesh, b, h, batch_axes, head_axis)
+
+    fn = functools.partial(
+        flash_attention_qkv,
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    if baxes is None and haxis is None:
+        return fn(qkv)  # nothing shardable — plain (replicated) call
+    sharded = _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(None, baxes, haxis, None, None),),
+        out_specs=P(baxes, haxis, None, None),
+        # The kernel is elementwise-independent across (b, h): outputs vary
+        # exactly like inputs; vma checking chokes on custom_vjp + pallas.
+        check_vma=False,
+    )
+    return sharded(qkv)
 
 
 def flash_attention(
