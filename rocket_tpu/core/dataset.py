@@ -45,6 +45,7 @@ class Dataset(Capsule):
         collate_fn: Optional[Callable] = None,
         device_placement: Optional[bool] = None,
         device_cache: str | bool = "auto",
+        fuse_gather: bool = True,
         prefetch: int = 2,
         statefull: bool = True,
         priority: int = 1000,
@@ -67,6 +68,11 @@ class Dataset(Capsule):
         # the runtime's HBM budget, eliminating per-step H2D traffic (the
         # dominant cost on TPU for small datasets — see data/device_cache.py).
         self._device_cache = device_cache
+        # Fused gather (cached path): attrs.batch is a gather MARKER that
+        # the Module materializes inside its compiled step — one device
+        # dispatch per step instead of two. Set False if a non-Module
+        # capsule consumes attrs.batch directly.
+        self._fuse_gather = bool(fuse_gather)
         self._device_resident = False
         self._dataloader: Optional[DataLoader] = None
         self._iterator = None
@@ -121,6 +127,7 @@ class Dataset(Capsule):
                         shuffle=self._loader_kwargs["shuffle"],
                         drop_last=self._loader_kwargs["drop_last"],
                         seed=runtime.seed,
+                        fused=self._fuse_gather,
                     )
                     store[id(self._raw_dataset)] = loader.cache
                     return loader

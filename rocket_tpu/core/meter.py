@@ -66,6 +66,13 @@ class Meter(Dispatcher):
         if attrs is None or attrs.batch is None:
             return
         batch = attrs.batch
+        if isinstance(batch, dict) and "_device_gather" in batch:
+            # A fused-gather marker reached the Meter un-materialized (no
+            # Module replaced the batch — e.g. a train-mode Meter over raw
+            # labels): gather the real rows eagerly so key access works.
+            from rocket_tpu.data.device_cache import materialize_marker
+
+            batch = attrs.batch = materialize_marker(batch)
         missing = [k for k in self._keys if not self._has_key(batch, k)]
         if missing:
             raise KeyError(

@@ -26,7 +26,20 @@ import numpy as np
 
 from rocket_tpu.data.loader import Batch
 
-__all__ = ["DeviceCachedLoader", "pytree_nbytes"]
+__all__ = ["DeviceCachedLoader", "materialize_marker", "pytree_nbytes"]
+
+
+def materialize_marker(batch: Any) -> Any:
+    """Eagerly gather a ``{"_device_gather": ...}`` marker batch into real
+    rows (one device dispatch). The fast path is the Module materializing
+    the marker INSIDE its compiled step; this helper keeps non-Module
+    consumers (Meter, custom capsules reading ``attrs.batch``) working when
+    ``Dataset(fuse_gather=True)`` is on. Non-marker batches pass through."""
+    if not (isinstance(batch, dict) and "_device_gather" in batch):
+        return batch
+    g = batch["_device_gather"]
+    idx = g["perm"][g["index"]]
+    return jax.tree.map(lambda l: jnp.take(l, idx, axis=0), g["cache"])
 
 
 def pytree_nbytes(tree: Any) -> int:
@@ -58,6 +71,7 @@ class DeviceCachedLoader:
         shuffle: bool = False,
         drop_last: bool = False,
         seed: int = 0,
+        fused: bool = True,
     ) -> None:
         leaves = jax.tree.leaves(data)
         if not leaves:
@@ -72,6 +86,14 @@ class DeviceCachedLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.seed = seed
+        # Fused mode: yield GATHER MARKERS ({"_device_gather": {cache, perm,
+        # index}}) instead of dispatching a per-batch gather call — the
+        # Module compiles the row gather INTO its train/eval step, so the
+        # steady-state loop costs ONE device dispatch per step instead of
+        # two. Through this environment's tunneled runtime a dispatch is
+        # ~1-2 ms, which dominated small-model steps (the MLP acceptance
+        # config measured 9.5 -> 2.3 ms/step from this fusion alone).
+        self.fused = fused
         self._runtime = runtime
         self._epoch = 0
         self._skip = 0
@@ -79,11 +101,19 @@ class DeviceCachedLoader:
         # One-time upload, replicated: every device can gather any row, and
         # the gather output is re-laid-out to the data-axis sharding below.
         # Already-on-device data (a cache shared by another loader over the
-        # same dataset) is used as-is.
+        # same dataset) is used as-is. Single-device runs use a PLAIN
+        # device_put: operands committed to a replicated NamedSharding
+        # measured ~1.4 ms/step slower through this environment's tunneled
+        # runtime than identically-shaped plainly-placed ones.
+        self._put = (
+            (lambda x: jax.device_put(x))
+            if jax.device_count() == 1
+            else (lambda x: jax.device_put(x, runtime.replicated))
+        )
         if all(isinstance(l, jax.Array) for l in leaves):
             self._cache = data
         else:
-            self._cache = jax.device_put(data, runtime.replicated)
+            self._cache = jax.tree.map(self._put, data)
 
         batch_sharding = runtime.batch_sharding
         replicated = runtime.replicated
@@ -151,11 +181,32 @@ class DeviceCachedLoader:
         skip, self._skip = self._skip, 0
         num_batches = len(self)
         # One per-epoch upload: the permutation (tiny vs the data).
-        self._perm = jax.device_put(self._make_perm(), self._runtime.replicated)
+        perm_host = self._make_perm()
+        remainder = self._n - (num_batches - 1) * self.batch_size
+
+        if self.fused:
+            # (num_batches, batch_size) layout: the in-step gather indexes
+            # row ``index`` — batch size stays a static shape, the index is
+            # a 0-d host scalar shipped with the step's arguments.
+            perm2 = self._put(perm_host.reshape(num_batches, self.batch_size))
+            for b in range(skip, num_batches):
+                real = self.batch_size
+                if not self.drop_last and b == num_batches - 1:
+                    real = remainder
+                marker = {
+                    "_device_gather": {
+                        "cache": self._cache,
+                        "perm": perm2,
+                        "index": np.asarray(b, np.int32),
+                    }
+                }
+                yield Batch(marker, size=real, index=b)
+            return
+
+        self._perm = jax.device_put(perm_host, self._runtime.replicated)
         counter = jax.device_put(
             jnp.asarray(skip, jnp.int32), self._runtime.replicated
         )
-        remainder = self._n - (num_batches - 1) * self.batch_size
         for b in range(skip, num_batches):
             data, counter = self._gather(self._cache, self._perm, counter)
             real = self.batch_size

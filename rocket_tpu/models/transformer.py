@@ -447,11 +447,6 @@ class TransformerLM(Model):
                 "TransformerConfig.pipeline_axis requires scan_layers=True "
                 "(stacked block params are the pipeline stages)."
             )
-        if c.num_experts > 0:
-            raise RuntimeError(
-                "pipeline_axis with num_experts (MoE aux loss through the "
-                "pipeline carry) is not supported yet."
-            )
         if self._pipe_mesh is None:
             from rocket_tpu.runtime.context import Runtime
 
@@ -467,6 +462,7 @@ class TransformerLM(Model):
 
         # One STABLE block_apply per mode — it keys the compiled-pipeline
         # cache, so a fresh closure per call would recompile every step.
+        moe = c.num_experts > 0
         block_apply = self._pipe_block_apply.get(mode)
         if block_apply is None:
             block = self.blocks[0]
@@ -480,10 +476,17 @@ class TransformerLM(Model):
                     r = jax.random.fold_in(r, mb)
                     if has_data:
                         r = jax.random.fold_in(r, jax.lax.axis_index("data"))
-                y, _ = block.apply(
+                y, bstate = block.apply(
                     {"params": params_i, "state": {}}, h,
                     mode=mode, rng=r, layer_idx=idx,
                 )
+                if moe:
+                    # Aux rides the pipeline's with_aux channel. NB: each
+                    # microbatch is its own GShard routing group, so the
+                    # pipelined aux is the microbatch-mean — the unpipelined
+                    # full-batch product differs slightly (they coincide at
+                    # num_microbatches=1).
+                    return y, bstate["aux_loss"]
                 return y
 
             self._pipe_block_apply[mode] = block_apply
@@ -498,6 +501,7 @@ class TransformerLM(Model):
             num_microbatches=c.pipeline_microbatches,
             remat=c.scan_remat,
             rng=rng,
+            with_aux=moe,
         )
 
     def apply(self, variables, batch, *, mode="train", rng=None):
@@ -526,7 +530,10 @@ class TransformerLM(Model):
         moe = self.config.num_experts > 0
         aux_total = jnp.zeros((), jnp.float32) if moe else None
         if self.config.pipeline_axis:
-            x = self._apply_pipelined(p, x, mode=mode, rng=rng)
+            if moe:
+                x, aux_total = self._apply_pipelined(p, x, mode=mode, rng=rng)
+            else:
+                x = self._apply_pipelined(p, x, mode=mode, rng=rng)
         elif self.config.scan_layers:
             block = self.blocks[0]  # one traced body serves every layer
 
@@ -557,7 +564,6 @@ class TransformerLM(Model):
                     aux_total = aux_total + bstate["aux_loss"]
 
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
-        # (pipeline path skips the MoE aux loss — see _apply_pipelined)
         out = dict(batch)
         if self.config.label_smoothing and mode == "train":
             # Train-only: eval loss stays plain CE, comparable to
@@ -595,7 +601,7 @@ class TransformerLM(Model):
             # upcasts to f32 for the softmax math (next_token_loss).
             logits = jnp.einsum("btd,vd->btv", x, p["wte"]["table"].astype(x.dtype))
             out[self.logits_key] = logits
-        if moe and not self.config.pipeline_axis:
+        if moe:
             # Pre-weighted router load-balancing loss; next_token_loss adds
             # it when present.
             out["moe_aux_loss"] = aux_total * self.config.moe_aux_weight
@@ -696,10 +702,14 @@ def generate(
     attention per token (:meth:`TransformerLM.decode_step`).
     ``use_cache=False`` recomputes the full causal prefix each step —
     O(T^2) per token, but exercises the exact training forward (useful for
-    cross-checking). Configs the cache path cannot replay faithfully —
-    ring attention (sequence-sharded K/V) and MoE (routing capacity is
-    computed over the full sequence in training but per step in decode) —
-    fall back to the recompute path automatically.
+    cross-checking). Ring attention (sequence-sharded K/V has no dense
+    cache to fill) falls back to the recompute path automatically. MoE
+    decodes through the cache: the prompt prefill routes with the whole
+    prompt as one GShard group (training semantics), then each generated
+    token routes alone — per-expert capacity is >= 1, so single-token
+    decode never drops to the residual path, where a training forward over
+    the same prefix might (capacity pressure from the other tokens). With
+    ample ``expert_capacity_factor`` the two paths agree exactly.
 
     ``temperature=0`` is greedy argmax (no key needed); otherwise pass a
     PRNG ``key``. ``top_k`` restricts sampling to the k most likely tokens;
@@ -712,10 +722,8 @@ def generate(
     both paths produce identical samples for the same key. Returns
     (B, prompt_len + max_new_tokens) int32.
     """
-    if use_cache and (
-        model.config.num_experts > 0 or model.config.attention_impl == "ring"
-    ):
-        use_cache = False  # see docstring — cache path would change semantics
+    if use_cache and model.config.attention_impl == "ring":
+        use_cache = False  # see docstring — no dense KV cache to fill
     prompt = jnp.asarray(prompt_tokens, jnp.int32)
     if prompt.ndim == 1:
         prompt = prompt[None, :]

@@ -53,6 +53,7 @@ def pipeline_blocks(
     num_microbatches: Optional[int] = None,
     remat: bool = True,
     rng: Optional[jax.Array] = None,
+    with_aux: bool = False,
 ):
     """Run ``x`` (B, T, D) through L stacked layers pipelined over
     ``pipe_axis``.
@@ -65,6 +66,16 @@ def pipeline_blocks(
     ``pipe_axis`` (and L divisible by the axis size). The batch dim may be
     sharded over ``data_axis``; activations are replicated over the pipe
     axis outside the shard_map.
+
+    ``with_aux=True``: ``block_apply`` returns ``(h, aux_scalar)`` (e.g. an
+    MoE load-balancing loss); the call returns ``(out, aux_total)`` =
+    sum over layers, mean over microbatches and data shards. NB each
+    microbatch/data shard is its own routing group, so a group-NONLINEAR
+    aux (the GShard fraction x gate product) equals the unpipelined
+    full-batch value only at num_microbatches=1 with no data sharding —
+    otherwise it is the mean of per-group losses, which is GShard's own
+    grouped formulation. Fill/drain ticks contribute nothing: their
+    compute is skipped outright (lax.cond, no masked garbage FLOPs).
     """
     n_stages = mesh.shape[pipe_axis]
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -95,6 +106,7 @@ def pipeline_blocks(
         num_layers,
         jax.tree.structure(stacked_params),
         rng is None,
+        with_aux,
     )
     fn = _CACHE.get(key)
     if fn is None:
@@ -108,18 +120,21 @@ def pipeline_blocks(
             remat=remat,
             n_stages=n_stages,
             layers_per_stage=num_layers // n_stages,
+            with_aux=with_aux,
         )
     return fn(stacked_params, x, rng)
 
 
 def _build(
     block_apply, params_treedef, *, mesh, pipe_axis, data_axis, m, remat,
-    n_stages, layers_per_stage,
+    n_stages, layers_per_stage, with_aux,
 ):
     batch_spec = P(data_axis, None, None)
     param_spec = jax.tree_util.tree_unflatten(
         params_treedef, [P(pipe_axis)] * params_treedef.num_leaves
     )
+
+    vary_axes = (pipe_axis,) + ((data_axis,) if data_axis else ())
 
     def stage_fn(local_params, x_local, rng):
         s = jax.lax.axis_index(pipe_axis)
@@ -128,47 +143,69 @@ def _build(
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def run_stage(h, mb):
-            def layer(h, xs):
+            def layer(carry, xs):
+                h, aux = carry
                 params_i, local_i = xs
-                return (
-                    block_apply(
-                        params_i, s * layers_per_stage + local_i, mb, h, rng
-                    ),
-                    None,
+                out = block_apply(
+                    params_i, s * layers_per_stage + local_i, mb, h, rng
                 )
+                if with_aux:
+                    h, layer_aux = out
+                    aux = aux + jnp.asarray(layer_aux, jnp.float32)
+                else:
+                    h = out
+                return (h, aux), None
 
-            h, _ = jax.lax.scan(
-                layer, h, (local_params, jnp.arange(layers_per_stage))
+            aux0 = pvary_compat(jnp.zeros((), jnp.float32), vary_axes)
+            (h, aux), _ = jax.lax.scan(
+                layer,
+                (h, aux0),
+                (local_params, jnp.arange(layers_per_stage)),
             )
-            return h
+            return h, aux
 
         if remat:
             run_stage = jax.checkpoint(run_stage)
 
         def tick(carry, t):
-            incoming, outputs = carry
-            # Microbatch this stage works on at tick t (clipped during
-            # fill/drain, where the compute is masked out anyway).
+            incoming, outputs, aux_acc = carry
+            # Microbatch this stage works on at tick t. During fill (the
+            # stage hasn't received its first microbatch yet) and drain
+            # (all m are through) the stage body is SKIPPED outright via
+            # lax.cond — no FLOPs burned on clipped garbage, where the old
+            # schedule ran the stage and masked the result.
             mb = jnp.clip(t - s, 0, m - 1)
+            valid = (t - s >= 0) & (t - s < m)
             feed = micro[jnp.clip(t, 0, m - 1)]
             h = jnp.where(s == 0, feed, incoming)
-            y = run_stage(h, mb)
+            y, aux = jax.lax.cond(
+                valid,
+                lambda h: run_stage(h, mb),
+                lambda h: (
+                    h,
+                    pvary_compat(jnp.zeros((), jnp.float32), vary_axes),
+                ),
+                h,
+            )
+            aux_acc = aux_acc + aux
             incoming = jax.lax.ppermute(y, pipe_axis, perm)
             out_idx = t - (n_stages - 1)
             write = (s == n_stages - 1) & (out_idx >= 0) & (out_idx < m)
             idx = jnp.clip(out_idx, 0, m - 1)
             outputs = outputs.at[idx].set(jnp.where(write, y, outputs[idx]))
-            return (incoming, outputs), None
+            return (incoming, outputs, aux_acc), None
 
         outputs = jnp.zeros_like(micro)
         incoming = jnp.zeros_like(micro[0])
+        aux_acc = jnp.zeros((), jnp.float32)
         # The carries become pipe-varying after one tick (they depend on
         # the stage index); mark the zero-initialized constants accordingly
         # so the scan carry types match (jax vma checking).
         incoming = pvary_compat(incoming, (pipe_axis,))
         outputs = pvary_compat(outputs, (pipe_axis,))
-        (_, outputs), _ = jax.lax.scan(
-            tick, (incoming, outputs), jnp.arange(m + n_stages - 1)
+        aux_acc = pvary_compat(aux_acc, vary_axes)
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (incoming, outputs, aux_acc), jnp.arange(m + n_stages - 1)
         )
         # Only the last stage holds real outputs; broadcast them to every
         # stage so the result is pipe-invariant (one (B,T,D) psum on ICI).
@@ -176,13 +213,24 @@ def _build(
             jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)),
             pipe_axis,
         )
-        return outputs.reshape(b_local, *x_local.shape[1:])
+        out = outputs.reshape(b_local, *x_local.shape[1:])
+        if not with_aux:
+            return out
+        # Per-layer aux scalars: sum over stages (each stage accumulated
+        # its local layers over its m valid ticks), average over
+        # microbatches, mean over data shards (the unpipelined path's aux
+        # is computed over the global batch).
+        aux_total = jax.lax.psum(aux_acc, pipe_axis) / m
+        if data_axis is not None:
+            aux_total = jax.lax.pmean(aux_total, data_axis)
+        return out, aux_total
 
+    out_specs = (batch_spec, P()) if with_aux else batch_spec
     fn = shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(param_spec, batch_spec, P()),
-        out_specs=batch_spec,
+        out_specs=out_specs,
     )
     # jit wrapper: the remat'ed stage body can't evaluate eagerly inside
     # shard_map; under an outer jit (the normal train step) this inlines.

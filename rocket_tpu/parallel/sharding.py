@@ -19,6 +19,7 @@ __all__ = [
     "fsdp_rules",
     "moe_rules",
     "pipeline_rules",
+    "pipeline_over",
     "combine_rules",
 ]
 
@@ -124,6 +125,33 @@ def moe_rules(
         if len(shape) <= offset:
             return None
         return (None,) * offset + (axis,) + (None,) * (len(shape) - offset - 1)
+
+    return rule_fn
+
+
+def pipeline_over(
+    inner: RuleFn,
+    axis: str = "pipe",
+    stacked_prefix: str = "blocks_stacked",
+) -> RuleFn:
+    """Compose pipeline-stage sharding WITH another rule set (dp x tp x pp):
+    stacked-layer leaves get their leading layer dim sharded over ``axis``
+    on top of whatever ``inner`` (e.g. ``gpt2_tp_rules()``) assigns to the
+    layer's own dims; non-stacked leaves follow ``inner`` unchanged.
+    (``combine_rules`` can't express this — it picks ONE rule set per leaf,
+    but pp x tp needs both axes on the same leaf.)"""
+
+    def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
+        spec = inner(path, leaf)
+        if not (path and path[0] == stacked_prefix):
+            return spec
+        shape = getattr(leaf, "shape", ())
+        if spec is None:
+            spec = (None,) * len(shape)
+        spec = tuple(spec) + (None,) * (len(shape) - len(spec))
+        # inner rule sets left-pad stacked leaves, leaving the layer dim
+        # None — claim it for the pipe axis.
+        return (axis,) + tuple(spec[1:])
 
     return rule_fn
 

@@ -1,0 +1,139 @@
+"""Decompose the ResNet-50 train step (BASELINE configs[3]) on one chip.
+
+Round-2 left the conv path at 14-17% MFU with no trace on record; the
+round-3 ask is >= 25% or a documented XLA-conv ceiling. This script times
+the full fused step and isolated pieces (fwd only, fwd+bwd, stem alone) and
+captures a ``jax.profiler`` trace whose per-op durations it summarizes
+(CAVEAT from SURVEY §6: summed op durations are NOT wall time — use them to
+rank sinks, never to claim speedups).
+
+Run on the real TPU: ``python scripts/profile_resnet50.py [--trace]``.
+"""
+
+import glob
+import gzip
+import json
+import sys
+import time
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(tree):
+    leaves = [l for l in jax.tree.leaves(tree) if isinstance(l, jax.Array)]
+    s = sum(jnp.sum(jnp.asarray(l, jnp.float32)) for l in leaves)
+    return float(s)
+
+
+def timeit(fn, *args, iters=10, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    B = 64
+    trace = "--trace" in sys.argv
+
+    import rocket_tpu as rt
+    from rocket_tpu import optim
+    from rocket_tpu.core.module import Module
+    from rocket_tpu.models.resnet import resnet50
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(seed=0)
+    model = resnet50(num_classes=1000)
+
+    def objective(b):
+        import optax
+
+        return optax.softmax_cross_entropy_with_integer_labels(
+            b["logits"], b["label"]
+        ).mean()
+
+    module = Module(
+        model,
+        capsules=[
+            rt.Loss(objective),
+            rt.Optimizer(optim.momentum(beta=0.9), learning_rate=0.1),
+        ],
+        compute_dtype=jnp.bfloat16,
+        runtime=runtime,
+    )
+    module.setup()
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jax.device_put(
+            rng.normal(size=(B, 224, 224, 3)).astype(np.float32)
+        ),
+        "label": jax.device_put(rng.integers(0, 1000, B).astype(np.int32)),
+    }
+
+    state = module.prepared.state
+    step = module._train_step
+
+    # The step donates its state arg — thread it through the timing loop.
+    def run_steps(n):
+        nonlocal state
+        metrics = None
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        return metrics
+
+    run_steps(2)
+    sync(run_steps(1)["loss"])
+    t0 = time.perf_counter()
+    metrics = run_steps(12)
+    sync(metrics["loss"])
+    t_step = (time.perf_counter() - t0) / 12
+    flops = 3 * 2 * 4.1e9 * B  # fwd+bwd ~3x fwd MACs, 2 FLOPs/MAC
+    peak = 197e12
+    print(f"full step: {t_step*1e3:.1f} ms  -> {B/t_step:.0f} img/s, "
+          f"MFU {flops/t_step/peak:.3f}")
+
+    # Forward only (eval step, same shapes, no BN-update difference in cost)
+    eval_step = module._eval_step
+    t_fwd = timeit(
+        lambda: eval_step(state["params"], state["model_state"], batch)["logits"],
+        iters=12,
+    )
+    print(f"fwd only:  {t_fwd*1e3:.1f} ms  ({t_fwd/t_step:.0%} of step)")
+
+    if trace:
+        tdir = "traces/resnet50"
+        with jax.profiler.trace(tdir):
+            metrics = run_steps(3)
+            sync(metrics["loss"])
+        # Find the trace.json.gz written by the profiler and rank op time.
+        files = sorted(glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True))
+        if not files:
+            print("no trace file found")
+            return
+        with gzip.open(files[-1], "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        by_name = defaultdict(float)
+        for e in events:
+            if e.get("ph") == "X" and e.get("dur") and "args" in e:
+                # TensorCore op rows carry 'long_name'/'name'
+                name = e.get("name", "?")
+                by_name[name] += e["dur"]
+        total = sum(by_name.values())
+        print(f"\ntop ops by summed duration (3 steps, total {total/1e3:.1f} ms):")
+        for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"  {dur/1e3:9.2f} ms  {dur/total:5.1%}  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

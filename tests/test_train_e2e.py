@@ -237,7 +237,12 @@ def test_batch_is_sharded_over_mesh(runtime8):
 
     data = make_dataset(n=64)
     rt.Launcher(
-        [rt.Looper([rt.Dataset(data, batch_size=64), ShardSpy()], tag="train")],
+        # fuse_gather=False: this spy consumes attrs.batch directly (no
+        # Module to materialize a gather marker inside its step).
+        [rt.Looper(
+            [rt.Dataset(data, batch_size=64, fuse_gather=False), ShardSpy()],
+            tag="train",
+        )],
         num_epochs=1,
         runtime=runtime8,
     ).launch()
@@ -246,6 +251,52 @@ def test_batch_is_sharded_over_mesh(runtime8):
     assert sharding.num_devices == 8
     shard_shape = sharding.shard_shape((64, 8))
     assert shard_shape == (8, 8)
+
+
+def test_fused_gather_marker_trains_and_matches_unfused(runtime8, tmp_path):
+    """Device-resident Datasets yield gather markers materialized INSIDE
+    the compiled step (one dispatch per step); losses must match the
+    unfused per-batch-gather path exactly (same cache, same permutation)."""
+    import numpy as np
+
+    def run(fuse):
+        runtime = rt.Runtime(
+            mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path)
+        )
+        model = MLP(in_features=8, num_classes=4, hidden=(16,))
+        data = make_dataset(n=128)
+        losses = []
+
+        class Spy(rt.Capsule):
+            def __init__(self):
+                super().__init__(priority=500)
+
+            def launch(self, attrs=None):
+                # The marker never leaks to downstream capsules' batch view
+                # in eval; in train attrs.batch stays whatever Dataset set.
+                losses.append(float(np.asarray(attrs.step_metrics.loss)))
+
+        module = rt.Module(
+            model,
+            capsules=[
+                rt.Loss(cross_entropy),
+                rt.Optimizer(optim.sgd(), learning_rate=0.1),
+            ],
+        )
+        rt.Launcher(
+            [rt.Looper(
+                [rt.Dataset(data, batch_size=32, fuse_gather=fuse,
+                            shuffle=True), module, Spy()],
+                tag="train", progress=False,
+            )],
+            num_epochs=2,
+            runtime=runtime,
+        ).launch()
+        return losses
+
+    fused, unfused = run(True), run(False)
+    assert len(fused) == len(unfused) == 8
+    np.testing.assert_allclose(fused, unfused, rtol=1e-5)
 
 
 @pytest.mark.parametrize("accum", [1, 2])
