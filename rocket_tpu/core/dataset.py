@@ -46,6 +46,7 @@ class Dataset(Capsule):
         device_placement: Optional[bool] = None,
         device_cache: str | bool = "auto",
         fuse_gather: bool = True,
+        num_workers: int = 0,
         prefetch: int = 2,
         statefull: bool = True,
         priority: int = 1000,
@@ -53,11 +54,16 @@ class Dataset(Capsule):
     ) -> None:
         super().__init__(statefull=statefull, priority=priority, runtime=runtime)
         self._raw_dataset = dataset
+        # num_workers: multiprocess batch loading on the STREAMING path
+        # (torch DataLoader(num_workers=N) parity, reference
+        # dataset.py:52-57); the device-resident cache path has no per-step
+        # host work and ignores it.
         self._loader_kwargs = dict(
             batch_size=batch_size,
             shuffle=shuffle,
             drop_last=drop_last,
             collate_fn=collate_fn,
+            num_workers=int(num_workers),
         )
         self._device_placement = device_placement
         # Streaming-path lookahead: collate + H2D run on a worker thread,
@@ -93,6 +99,8 @@ class Dataset(Capsule):
             self._loader_kwargs["shuffle"],
             self._loader_kwargs["drop_last"],
             id(self._loader_kwargs["collate_fn"]),
+            self._loader_kwargs["num_workers"],
+            self._fuse_gather,
         )
         prepared = runtime.dataloaders.lookup(self._raw_dataset, self._registry_key)
         if prepared is None:
@@ -219,6 +227,8 @@ class Dataset(Capsule):
         # Unregister before nulling the handle (fixes dataset.py:129-142).
         if self._dataloader is not None and self._runtime is not None:
             self._runtime.dataloaders.remove(self._raw_dataset, self._registry_key)
+        if self._dataloader is not None and hasattr(self._dataloader, "close"):
+            self._dataloader.close()  # stop worker processes promptly
         self._dataloader = None
         self._close_iterator()
         super().destroy(attrs)

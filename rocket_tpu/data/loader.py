@@ -74,6 +74,7 @@ class DataLoader:
         seed: int = 0,
         process_index: int = 0,
         process_count: int = 1,
+        num_workers: int = 0,
     ) -> None:
         if batch_size < 1:
             raise ValueError(f"DataLoader: batch_size must be >= 1, got {batch_size}")
@@ -99,6 +100,15 @@ class DataLoader:
                 f"DataLoader: dataset {type(dataset).__name__} is neither "
                 "map-style nor iterable."
             )
+        # Multiprocess batch loading (torch num_workers parity, reference
+        # dataset.py:52-57) — map-style only (workers need random access).
+        self.num_workers = int(num_workers)
+        if self.num_workers and not self._map_style:
+            raise ValueError(
+                "DataLoader: num_workers requires a map-style dataset "
+                "(__len__ + __getitem__)."
+            )
+        self._worker_pool = None
 
     # -- sizing ------------------------------------------------------------
 
@@ -146,17 +156,14 @@ class DataLoader:
         else:
             yield from self._iter_iterable(skip)
 
-    def _iter_map_style(self, skip: int) -> Iterator[Batch]:
+    def _batch_host_indices(self, skip: int):
+        """(host_idx, real, b) per batch — the single source of the epoch's
+        index math for both the serial and multiprocess paths."""
         n = len(self.dataset)
         order = self._epoch_indices(n)
         num_batches = len(self)
         stripe = self.batch_size // self.process_count
         lo = self.process_index * stripe
-
-        # Fast path: a dataset exposing get_batch(indices) -> collated batch
-        # skips per-sample Python dispatch (keeps the host ahead of the chip).
-        get_batch = getattr(self.dataset, "get_batch", None)
-
         for b in range(skip, num_batches):
             start = b * self.batch_size
             global_idx = order[start : start + self.batch_size]
@@ -168,12 +175,44 @@ class DataLoader:
                 # hang the next collective in multihost runs.
                 pad = np.resize(order, self.batch_size - real)
                 global_idx = np.concatenate([global_idx, pad])
-            host_idx = global_idx[lo : lo + stripe]
+            yield global_idx[lo : lo + stripe], real, b
+
+    def _iter_map_style(self, skip: int) -> Iterator[Batch]:
+        if self.num_workers:
+            if self._worker_pool is None:
+                from rocket_tpu.data.workers import WorkerPool
+
+                self._worker_pool = WorkerPool(
+                    self.dataset, self.collate_fn, self.num_workers,
+                    seed=self.seed,
+                )
+            meta = []
+
+            def indices():
+                for host_idx, real, b in self._batch_host_indices(skip):
+                    meta.append((real, b))
+                    yield host_idx
+
+            for data in self._worker_pool.imap(indices()):
+                real, b = meta.pop(0)
+                yield Batch(data, size=real, index=b)
+            return
+
+        # Fast path: a dataset exposing get_batch(indices) -> collated batch
+        # skips per-sample Python dispatch (keeps the host ahead of the chip).
+        get_batch = getattr(self.dataset, "get_batch", None)
+        for host_idx, real, b in self._batch_host_indices(skip):
             if get_batch is not None:
                 data = get_batch(host_idx)
             else:
                 data = self.collate_fn([self.dataset[int(i)] for i in host_idx])
             yield Batch(data, size=real, index=b)
+
+    def close(self) -> None:
+        """Shut down worker processes (no-op without num_workers)."""
+        pool, self._worker_pool = self._worker_pool, None
+        if pool is not None:
+            pool.close()
 
     def _iter_iterable(self, skip: int) -> Iterator[Batch]:
         stripe = self.batch_size // self.process_count

@@ -148,9 +148,11 @@ def pipeline_over(
         shape = getattr(leaf, "shape", ())
         if spec is None:
             spec = (None,) * len(shape)
-        spec = tuple(spec) + (None,) * (len(shape) - len(spec))
-        # inner rule sets left-pad stacked leaves, leaving the layer dim
-        # None — claim it for the pipe axis.
+        # A short spec from a stacked-UNAWARE inner rule describes the
+        # layer's natural dims — the missing dim is the LEADING layer dim,
+        # so pad on the left (the same convention make_rules uses).
+        spec = (None,) * (len(shape) - len(spec)) + tuple(spec)
+        # The layer dim is now None — claim it for the pipe axis.
         return (axis,) + tuple(spec[1:])
 
     return rule_fn
