@@ -80,27 +80,31 @@ def _kv_block(h_kv: int, g: int, d: int, q_total: int, kv_total: int) -> int:
     def ok(width, total):
         return width % 128 == 0 or width == total
 
-    for kb in range(1, h_kv):
-        if h_kv % kb:
-            continue
-        if ok(kb * g * d, q_total) and ok(kb * d, kv_total):
-            # For g=1 at small D prefer at least two heads per step when
-            # legal (half-empty 64-lane tiles otherwise).
-            if g == 1 and d < 128 and kb == 1 and h_kv % 2 == 0:
-                continue
-            return kb
-    return h_kv
+    legal = [
+        kb for kb in range(1, h_kv + 1)
+        if h_kv % kb == 0
+        and ok(kb * g * d, q_total) and ok(kb * d, kv_total)
+    ]
+    if not legal:
+        return h_kv  # whole-feature blocks always satisfy the width rule
+    # Among legal blockings prefer a ~256-lane q tile: chip A/B at GPT-2
+    # shapes measured kb=4 (256 lanes) ~15% faster than kb=2 (128) and
+    # kb=6 (384) ~2x slower (VMEM/register pressure past two lane tiles).
+    return min(legal, key=lambda kb: (abs(kb * g * d - 256), kb))
 
 
 def _fused_kb(h: int, d: int) -> Optional[int]:
     """kb for the single-operand fused path, or None when no legal blocking
     exists (the fused feature dim 3*H*D is never equal to a block width, so
     widths must be true 128-multiples; callers then fall back to sliced
-    operands)."""
-    for kb in range(1, h + 1):
-        if h % kb == 0 and (kb * d) % 128 == 0:
-            return kb
-    return None
+    operands). Same ~256-lane preference as :func:`_kv_block`."""
+    legal = [
+        kb for kb in range(1, h + 1)
+        if h % kb == 0 and (kb * d) % 128 == 0
+    ]
+    if not legal:
+        return None
+    return min(legal, key=lambda kb: (abs(kb * d - 256), kb))
 
 
 def _causal_mask_t(s):
