@@ -73,6 +73,10 @@ class TransformerConfig:
     num_experts: int = 0
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
+    #: "einsum" (default; one-hot dispatch, clean all-to-alls under expert
+    #: sharding but O(B*T^2) memory) or "scatter" (linear in T — prefer for
+    #: long sequences without an 'expert' mesh axis). See ``nn/moe.py``.
+    expert_dispatch: str = "einsum"
     #: Aux load-balancing loss weight, surfaced as batch["moe_aux_loss"]
     #: and added by ``next_token_loss``.
     moe_aux_weight: float = 0.01
@@ -205,6 +209,7 @@ class Block(Layer):
                 c.dim, c.mlp_ratio * c.dim, c.num_experts,
                 top_k=c.expert_top_k,
                 capacity_factor=c.expert_capacity_factor,
+                dispatch=c.expert_dispatch,
             )
             self.fc_in = self.fc_out = self.fc_gate = None
         else:

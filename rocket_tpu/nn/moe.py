@@ -44,16 +44,29 @@ class MoE(Layer):
         num_experts: int,
         top_k: int = 2,
         capacity_factor: float = 1.25,
+        dispatch: str = "einsum",
     ):
         if not 1 <= top_k <= num_experts:
             raise ValueError(
                 f"MoE: top_k {top_k} must be in [1, num_experts={num_experts}]"
             )
+        if dispatch not in ("einsum", "scatter"):
+            raise ValueError(f"MoE: unknown dispatch mode {dispatch!r}")
         self.dim = dim
         self.hidden = hidden
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        #: "einsum": one-hot dispatch/combine tensors (B, T, E, C) — with
+        #: C = cf*k*T/E that is cf*k*B*T^2 elements INDEPENDENT of E, the
+        #: memory ceiling at long T. GSPMD lowers these einsums to clean
+        #: all-to-alls under expert sharding, so it stays the default.
+        #: "scatter": scatter-add dispatch / gather combine — O(k*B*T*D),
+        #: linear in T; prefer it for long sequences (T >= ~2048) when the
+        #: experts are NOT sharded over a mesh axis (XLA's scatter does not
+        #: lower to all-to-alls as cleanly). Both modes compute identical
+        #: outputs (tested).
+        self.dispatch = dispatch
         self.router = Dense(dim, num_experts, use_bias=False)
 
     def init_params(self, key):
@@ -100,30 +113,54 @@ class MoE(Layer):
         slot = jnp.sum(position * choice_onehot, axis=-1)  # (B, K*T)
         keep = slot < capacity
 
-        # Dispatch/combine tensors (B, T, E, C).
-        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype) * keep[
-            ..., None
-        ].astype(x.dtype)  # (B, K*T, C)
-        dispatch_kc = (
-            choice_onehot.astype(x.dtype)[..., :, None]
-            * slot_onehot[..., None, :]
-        ).reshape(b, k, t, e, capacity)
-        dispatch = jnp.sum(dispatch_kc, axis=1)  # (B, T, E, C) 0/1
-        combine = jnp.sum(
-            dispatch_kc
-            * jnp.swapaxes(top_gates, 1, 2)[..., None, None].astype(x.dtype),
-            axis=1,
-        )  # (B, T, E, C) gate-weighted
+        if self.dispatch == "scatter":
+            # Linear-in-T dispatch: scatter tokens into (B, E, C, D) expert
+            # slots, run the experts, gather back. k-major flat order:
+            # position j = choice*T + token, matching flat_idx/slot above.
+            slot_c = jnp.minimum(slot, capacity - 1)
+            b_ix = jnp.arange(b)[:, None]
+            xk = jnp.tile(x, (1, k, 1))  # (B, K*T, D), k-major
+            upd = jnp.where(keep[..., None], xk, jnp.zeros_like(xk))
+            expert_in = jnp.swapaxes(
+                jnp.zeros((b, e, capacity, d), x.dtype)
+                .at[b_ix, flat_idx, slot_c]
+                .add(upd),
+                0, 1,
+            )  # (E, B, C, D)
+        else:
+            # Dispatch/combine tensors (B, T, E, C).
+            slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype) * keep[
+                ..., None
+            ].astype(x.dtype)  # (B, K*T, C)
+            dispatch_kc = (
+                choice_onehot.astype(x.dtype)[..., :, None]
+                * slot_onehot[..., None, :]
+            ).reshape(b, k, t, e, capacity)
+            dispatch = jnp.sum(dispatch_kc, axis=1)  # (B, T, E, C) 0/1
+            combine = jnp.sum(
+                dispatch_kc
+                * jnp.swapaxes(top_gates, 1, 2)[..., None, None].astype(x.dtype),
+                axis=1,
+            )  # (B, T, E, C) gate-weighted
+            expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
 
         # -- expert computation (E leading; shard E over 'expert' — GSPMD
-        # lowers the dispatch/combine einsums to all-to-alls) -------------
+        # lowers the einsum-mode dispatch/combine to all-to-alls) ---------
         ex = p["experts"]
-        expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
         h = jnp.einsum("ebcd,edh->ebch", expert_in, ex["w_in"].astype(x.dtype))
         h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[:, None, None, :])
         out = jnp.einsum("ebch,ehd->ebcd", h, ex["w_out"].astype(x.dtype))
         out = out + ex["b_out"].astype(x.dtype)[:, None, None, :]
-        y = jnp.einsum("btec,ebcd->btd", combine, out)
+
+        if self.dispatch == "scatter":
+            picked = jnp.swapaxes(out, 0, 1)[b_ix, flat_idx, slot_c]  # (B,K*T,D)
+            picked = jnp.where(keep[..., None], picked, jnp.zeros_like(picked))
+            gates_k = (
+                jnp.swapaxes(top_gates, 1, 2).reshape(b, k * t, 1).astype(x.dtype)
+            )
+            y = jnp.sum((picked * gates_k).reshape(b, k, t, d), axis=1)
+        else:
+            y = jnp.einsum("btec,ebcd->btd", combine, out)
 
         # -- load-balancing aux loss (GShard eq. 4) -----------------------
         primary = jax.nn.one_hot(top_idx[..., 0], e, dtype=jnp.float32)
