@@ -44,6 +44,9 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None
     mlp_ratio: int = 4
     dropout: float = 0.0
+    #: Causal (decoder) attention by default; False builds encoder blocks
+    #: (ViT reuses Block this way — ``models/vit.py``).
+    causal: bool = True
     tied_embeddings: bool = True
     #: "auto" | "xla" | "flash" | "ring" — see ``nn.attention.resolve_impl``;
     #: "ring" shards the sequence over the mesh's ``seq_axis`` (long-context
@@ -197,7 +200,7 @@ class Block(Layer):
         norm_cls = c.norm_cls()
         self.ln1 = norm_cls(c.dim)
         self.attn = MultiHeadAttention(
-            c.dim, c.num_heads, num_kv_heads=c.num_kv_heads, causal=True,
+            c.dim, c.num_heads, num_kv_heads=c.num_kv_heads, causal=c.causal,
             dropout=c.dropout, impl=c.attention_impl, seq_axis=c.seq_axis,
             rope=c.pos_embedding == "rope", rope_base=c.rope_base,
         )

@@ -1,0 +1,141 @@
+"""Vision Transformer (ViT) — patch embedding + non-causal encoder.
+
+Third transformer family next to GPT-2 and Llama-style decoders: exercises
+NON-causal attention (the flash kernel's full-block path — every KV block
+is an interior block, no diagonal masking), learned position embeddings
+over patches, and a classification head over a CLS token. The reference
+carries no model code (SURVEY §0); this is user-space surface the
+framework ships for the conv/attention hybrid regime.
+
+The encoder trunk REUSES :class:`rocket_tpu.models.transformer.Block`
+(``TransformerConfig(causal=False)``), so ViT inherits every decoder-block
+capability — flash/XLA attention selection, norm/MLP variants, scanned
+layers — rather than duplicating the block.
+
+TPU notes: the patch embedding is one strided conv = a single MXU matmul
+over (P*P*C, D). The token count (patches + CLS, e.g. 32/4 -> 65 or
+224/16 -> 197) is not a flash block multiple, so attention rides the XLA
+path — the right call at these short sequence lengths anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.models.transformer import Block, TransformerConfig
+from rocket_tpu.nn.layers import Conv2D, Dense, Dropout, LayerNorm
+from rocket_tpu.nn.module import Model, Variables
+
+__all__ = ["ViT", "vit_tiny", "vit_small"]
+
+
+class ViT(Model):
+    """Batch contract: reads ``batch["image"]`` (B, H, W, C), writes
+    ``batch["logits"]`` (B, num_classes). Classification via a learned CLS
+    token (the ViT paper's head)."""
+
+    def __init__(
+        self,
+        image_size: int = 32,
+        patch_size: int = 4,
+        in_channels: int = 3,
+        num_classes: int = 10,
+        dim: int = 192,
+        depth: int = 9,
+        num_heads: int = 3,
+        mlp_ratio: int = 4,
+        dropout: float = 0.0,
+        image_key: str = "image",
+        logits_key: str = "logits",
+    ):
+        if image_size % patch_size:
+            raise ValueError(
+                f"ViT: image_size {image_size} not divisible by patch_size "
+                f"{patch_size}"
+            )
+        self.num_patches = (image_size // patch_size) ** 2
+        self.dim = dim
+        # Encoder blocks = decoder Blocks with causal=False.
+        self.config = TransformerConfig(
+            vocab_size=1,  # unused: ViT owns its own embedding + head
+            max_seq_len=self.num_patches + 1,
+            dim=dim,
+            num_layers=depth,
+            num_heads=num_heads,
+            mlp_ratio=mlp_ratio,
+            dropout=dropout,
+            causal=False,
+        )
+        # Patch embedding as a strided conv: one (P*P*C -> D) matmul.
+        self.patch = Conv2D(
+            in_channels, dim, kernel_size=patch_size, stride=patch_size,
+            padding="VALID",
+        )
+        self.blocks = [Block(self.config, i) for i in range(depth)]
+        self.ln_f = LayerNorm(dim)
+        self.head = Dense(dim, num_classes)
+        self.dropout = Dropout(dropout) if dropout else None
+        self.image_key = image_key
+        self.logits_key = logits_key
+
+    def init(self, key: jax.Array) -> Variables:
+        keys = jax.random.split(key, len(self.blocks) + 4)
+        params = {
+            "patch": self.patch.init(keys[0])["params"],
+            "cls": jax.random.normal(keys[1], (1, 1, self.dim)) * 0.02,
+            "pos": jax.random.normal(
+                keys[2], (1, self.num_patches + 1, self.dim)
+            ) * 0.02,
+            "blocks": {
+                str(i): blk.init_params(keys[3 + i])
+                for i, blk in enumerate(self.blocks)
+            },
+            "ln_f": self.ln_f.init(keys[-1])["params"],
+            "head": self.head.init(jax.random.fold_in(key, 99))["params"],
+        }
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        p = variables["params"]
+        x = batch[self.image_key]
+        if x.ndim == 3:
+            x = x[..., None]
+        b = x.shape[0]
+
+        x, _ = self.patch.apply({"params": p["patch"], "state": {}}, x)
+        x = x.reshape(b, self.num_patches, self.dim)
+        cls = jnp.broadcast_to(p["cls"].astype(x.dtype), (b, 1, self.dim))
+        x = jnp.concatenate([cls, x], axis=1) + p["pos"].astype(x.dtype)
+        if self.dropout is not None:
+            x, _ = self.dropout.apply(
+                {"params": {}, "state": {}}, x, mode=mode,
+                rng=None if rng is None else jax.random.fold_in(rng, 0xA11),
+            )
+
+        for i, blk in enumerate(self.blocks):
+            x, _ = blk.apply(
+                {"params": p["blocks"][str(i)], "state": {}}, x, mode=mode,
+                rng=rng,
+            )
+
+        x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
+        logits, _ = self.head.apply(
+            {"params": p["head"], "state": {}}, x[:, 0]
+        )
+        out = dict(batch)
+        out[self.logits_key] = logits
+        return out, variables["state"]
+
+
+def vit_tiny(image_size=32, patch_size=4, num_classes=10, **kw) -> ViT:
+    """ViT-Ti-ish at CIFAR scale (d=192, 9 blocks, 3 heads)."""
+    return ViT(image_size, patch_size, num_classes=num_classes, **kw)
+
+
+def vit_small(image_size=224, patch_size=16, num_classes=1000, **kw) -> ViT:
+    """ViT-S/16 (d=384, 12 blocks, 6 heads)."""
+    return ViT(
+        image_size, patch_size, num_classes=num_classes,
+        dim=384, depth=12, num_heads=6, **kw,
+    )
