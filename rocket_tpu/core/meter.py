@@ -39,12 +39,26 @@ class Meter(Dispatcher):
         self,
         keys: Sequence[str],
         capsules: Iterable[Capsule] = (),
+        gather_on: str = "all",
         statefull: bool = False,
         priority: int = 1000,
         runtime=None,
     ) -> None:
+        """``gather_on``: where host-path metrics run in MULTIHOST runs.
+        "all" (default, reference ``gather_for_metrics`` semantics): every
+        host keeps the gathered global batch and dispatches its metric
+        children — O(global batch) host RAM retained per host. "main":
+        every host still participates in the collective (it must), but
+        non-main hosts drop the arrays immediately and skip host-path
+        children — only the main process retains the global batch and
+        accumulates metrics (read results there). ``Metric.device_reduce``
+        children are unaffected (they never gather to host) and remain the
+        recommended path at scale."""
         super().__init__(capsules, statefull=statefull, priority=priority, runtime=runtime)
+        if gather_on not in ("all", "main"):
+            raise ValueError(f"Meter: gather_on must be 'all'|'main', got {gather_on!r}")
         self._keys = tuple(keys)
+        self._gather_on = gather_on
         self._reduce_fns: dict = {}  # id(metric) -> jitted device_reduce
 
     def gather_for_metrics(self, value, real_size: Optional[int]):
@@ -55,7 +69,13 @@ class Meter(Dispatcher):
             else:
                 from jax.experimental import multihost_utils
 
-                host = np.asarray(multihost_utils.process_allgather(value))
+                # tiled=True: the value is already a GLOBAL array sharded
+                # over processes — assemble it along its existing leading
+                # axis (untiled would try to stack a new process dim and
+                # rejects non-fully-addressable inputs).
+                host = np.asarray(
+                    multihost_utils.process_allgather(value, tiled=True)
+                )
         else:
             host = np.asarray(value)
         if real_size is not None and host.ndim >= 1 and host.shape[0] > real_size:
@@ -107,6 +127,19 @@ class Meter(Dispatcher):
             else:
                 host_kids.append(child)
         if not host_kids:
+            return
+
+        main_only = (
+            self._gather_on == "main"
+            and self._runtime is not None
+            and self._runtime.process_count > 1
+        )
+        if main_only and not self._runtime.is_main_process:
+            # Participate in the collectives (they're collective), but drop
+            # the global arrays immediately and skip host-path children —
+            # only the main process retains O(global batch) and accumulates.
+            for key in self._keys:
+                self.gather_for_metrics(batch[key], real_size)
             return
 
         gathered = {

@@ -162,3 +162,36 @@ def test_topk_accuracy_and_perplexity():
     meter2.launch(attrs2)
     meter2.reset(Attributes())
     assert abs(ppl.value - V) < 1e-3
+
+
+def test_gather_on_validation_and_single_host_noop():
+    import pytest
+
+    from rocket_tpu.core.meter import Meter
+
+    with pytest.raises(ValueError, match="gather_on"):
+        Meter(["x"], gather_on="rank0")
+
+    # Single-host: gather_on="main" behaves exactly like "all".
+    import jax.numpy as jnp
+
+    from rocket_tpu.core.attributes import Attributes
+    from rocket_tpu.core.meter import Metric
+    from rocket_tpu.runtime.context import Runtime
+
+    seen = []
+
+    class Spy(Metric):
+        def launch(self, attrs=None):
+            seen.append(np.asarray(attrs.batch["x"]).copy())
+
+        def reset(self, attrs=None):
+            pass
+
+    runtime = Runtime(seed=0)
+    meter = Meter(["x"], [Spy()], gather_on="main", runtime=runtime)
+    attrs = Attributes()
+    attrs.batch = {"x": jnp.arange(6.0)}
+    attrs.batch_info = Attributes(size=4, index=0)
+    meter.launch(attrs)
+    assert len(seen) == 1 and seen[0].shape == (4,)  # padding trimmed

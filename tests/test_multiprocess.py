@@ -112,6 +112,44 @@ assert int(_np.asarray(module2.state["step"])) == 4, module2.state["step"]
 assert module2._prepared.host_step == 4
 tree2.destroy(attrs)
 runtime.wait_for_everyone()
+
+# Meter(gather_on="main") across processes: every rank participates in the
+# gather collectives (no hang), but only rank 0 retains the global batch
+# and accumulates host-path metrics.
+from rocket_tpu.core.meter import Metric
+
+class CountSamples(Metric):
+    def __init__(self):
+        super().__init__()
+        self.total = 0
+
+    def launch(self, attrs=None):
+        self.total += int(attrs.batch["label"].shape[0])
+
+    def reset(self, attrs=None):
+        pass
+
+counter = CountSamples()
+model3 = MLP(in_features=8, num_classes=4, hidden=(16,))
+rt.Launcher(
+    [
+        rt.Looper(
+            [
+                rt.Dataset(data, batch_size=32),
+                rt.Module(model3),
+                rt.Meter(["logits", "label"], [counter], gather_on="main"),
+            ],
+            tag="val",
+            grad_enabled=False,
+            progress=False,
+        )
+    ],
+    num_epochs=1,
+    runtime=runtime,
+).launch()
+expected = 128 if rank == 0 else 0
+assert counter.total == expected, (rank, counter.total)
+runtime.wait_for_everyone()
 print(f"RANK{rank} OK", flush=True)
 """
 
