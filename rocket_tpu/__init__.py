@@ -28,7 +28,7 @@ from rocket_tpu.core import (
 )
 from rocket_tpu.runtime.context import Runtime
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "Attributes",
