@@ -47,6 +47,7 @@ class Dataset(Capsule):
         device_cache: str | bool = "auto",
         fuse_gather: bool = True,
         num_workers: int = 0,
+        worker_start_method: str = "fork",
         prefetch: int = 2,
         statefull: bool = True,
         priority: int = 1000,
@@ -57,13 +58,17 @@ class Dataset(Capsule):
         # num_workers: multiprocess batch loading on the STREAMING path
         # (torch DataLoader(num_workers=N) parity, reference
         # dataset.py:52-57); the device-resident cache path has no per-step
-        # host work and ignores it.
+        # host work and ignores it. worker_start_method: "fork" (default)
+        # inherits the dataset copy-on-write but forks from a multi-threaded
+        # parent — if a lock held by another library at fork time deadlocks
+        # a worker, pass "spawn" (pickles the dataset into each worker once).
         self._loader_kwargs = dict(
             batch_size=batch_size,
             shuffle=shuffle,
             drop_last=drop_last,
             collate_fn=collate_fn,
             num_workers=int(num_workers),
+            worker_start_method=worker_start_method,
         )
         self._device_placement = device_placement
         # Streaming-path lookahead: collate + H2D run on a worker thread,
@@ -100,12 +105,15 @@ class Dataset(Capsule):
             self._loader_kwargs["drop_last"],
             id(self._loader_kwargs["collate_fn"]),
             self._loader_kwargs["num_workers"],
+            self._loader_kwargs["worker_start_method"],
             self._fuse_gather,
         )
         prepared = runtime.dataloaders.lookup(self._raw_dataset, self._registry_key)
         if prepared is None:
             prepared = self._make_loader(runtime)
             runtime.dataloaders.add(self._raw_dataset, prepared, self._registry_key)
+        # Holder count: a shared loader is closed only by its LAST capsule.
+        runtime.dataloaders.retain(self._raw_dataset, self._registry_key)
         self._dataloader = prepared
         self._device_resident = isinstance(prepared, DeviceCachedLoader)
         if self._device_placement is None:
@@ -225,10 +233,17 @@ class Dataset(Capsule):
 
     def destroy(self, attrs: Attributes | None = None) -> None:
         # Unregister before nulling the handle (fixes dataset.py:129-142).
-        if self._dataloader is not None and self._runtime is not None:
-            self._runtime.dataloaders.remove(self._raw_dataset, self._registry_key)
-        if self._dataloader is not None and hasattr(self._dataloader, "close"):
-            self._dataloader.close()  # stop worker processes promptly
+        # The loader may be shared by another capsule still mid-epoch
+        # (identity-deduped registry): only the LAST holder closes it and
+        # its worker pool (round-3 advisor finding).
+        if self._dataloader is not None:
+            last = True
+            if self._runtime is not None:
+                last = self._runtime.dataloaders.release(
+                    self._raw_dataset, self._registry_key
+                )
+            if last and hasattr(self._dataloader, "close"):
+                self._dataloader.close()  # stop worker processes promptly
         self._dataloader = None
         self._close_iterator()
         super().destroy(attrs)

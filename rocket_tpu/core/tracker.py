@@ -34,6 +34,7 @@ __all__ = [
     "Tracker",
     "JsonlBackend",
     "TensorBoardBackend",
+    "WandbBackend",
     "register_tracker_backend",
 ]
 
@@ -75,7 +76,42 @@ class TensorBoardBackend:
         self._writer.close()
 
 
-_BACKENDS = {"jsonl": JsonlBackend, "tensorboard": TensorBoardBackend}
+class WandbBackend:
+    """Weights & Biases adapter — the reference ecosystem's most common
+    tracker (``accelerate log_with="wandb"``, reference ``tracker.py:30-46``),
+    shipped to prove :func:`register_tracker_backend`'s duck-typed contract
+    against a real third-party API shape.
+
+    Import-guarded: ``wandb`` is not baked into this image, so selecting
+    ``Tracker(backend="wandb")`` without it installed raises ImportError in
+    the factory, which ``Tracker.setup`` catches and downgrades to the jsonl
+    backend with a warning.
+    """
+
+    def __init__(self, project: str, directory: str = "runs") -> None:
+        import wandb  # noqa: F401 — ImportError here triggers jsonl fallback
+
+        self._wandb = wandb
+        self._run = wandb.init(project=project, dir=directory)
+
+    def log_scalars(self, scalars: dict, step: int) -> None:
+        self._run.log(dict(scalars), step=step)
+
+    def log_images(self, images: dict, step: int) -> None:
+        self._run.log(
+            {k: self._wandb.Image(np.asarray(v)) for k, v in images.items()},
+            step=step,
+        )
+
+    def close(self) -> None:
+        self._run.finish()
+
+
+_BACKENDS = {
+    "jsonl": JsonlBackend,
+    "tensorboard": TensorBoardBackend,
+    "wandb": WandbBackend,
+}
 
 
 def register_tracker_backend(name: str, factory) -> None:

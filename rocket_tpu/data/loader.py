@@ -75,6 +75,7 @@ class DataLoader:
         process_index: int = 0,
         process_count: int = 1,
         num_workers: int = 0,
+        worker_start_method: str = "fork",
     ) -> None:
         if batch_size < 1:
             raise ValueError(f"DataLoader: batch_size must be >= 1, got {batch_size}")
@@ -102,7 +103,16 @@ class DataLoader:
             )
         # Multiprocess batch loading (torch num_workers parity, reference
         # dataset.py:52-57) — map-style only (workers need random access).
+        # worker_start_method: "fork" (default, torch's Linux model — the
+        # dataset is inherited copy-on-write, never pickled) or "spawn".
+        # CAVEAT (round-3 advisor): fork happens from a multi-threaded
+        # parent (jax runtime threads are already running); jax itself is
+        # never called in workers, but any OTHER lock held at fork time
+        # (logging handlers, user library threads touched by __getitem__)
+        # can deadlock a worker — switch to "spawn" if workers hang, at the
+        # cost of pickling the dataset into each worker once.
         self.num_workers = int(num_workers)
+        self.worker_start_method = worker_start_method
         if self.num_workers and not self._map_style:
             raise ValueError(
                 "DataLoader: num_workers requires a map-style dataset "
@@ -184,6 +194,7 @@ class DataLoader:
 
                 self._worker_pool = WorkerPool(
                     self.dataset, self.collate_fn, self.num_workers,
+                    start_method=self.worker_start_method,
                     seed=self.seed,
                 )
             meta = []

@@ -81,10 +81,13 @@ class WorkerPool:
             )
         self._num_workers = num_workers
         # "fork" inherits the dataset copy-on-write (no pickling, torch's
-        # Linux model). jax warns fork may deadlock under its runtime
-        # threads; workers never call jax, so the inherited locks are never
-        # taken — pass start_method="spawn" for full isolation at the cost
-        # of pickling the dataset into each worker once.
+        # Linux model). The parent is multi-threaded by the time a pool
+        # exists (jax runtime threads): workers never call jax so ITS locks
+        # are never taken, but any other lock held at fork time (logging
+        # handlers, user library threads reached by __getitem__) can
+        # deadlock a worker. start_method="spawn" — selectable from
+        # Dataset/DataLoader(worker_start_method=...) — gives full
+        # isolation at the cost of pickling the dataset into each worker.
         ctx = multiprocessing.get_context(start_method)
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -37,15 +38,39 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-#: A non-zero exit this early into a run is treated as "coordinator failed
-#: to start" (e.g. the probed port was taken) and retried on a new port.
+#: A non-zero exit this early into a run MAY be a coordinator-port race —
+#: but elapsed time alone is not evidence (round-3 advisor: a script that
+#: fails fast deterministically must not be re-run, repeating its side
+#: effects). The retry additionally requires a distributed-init error
+#: signature in the worker output (matched below).
 _STARTUP_WINDOW_S = 15.0
 _MAX_PORT_RETRIES = 2
 
+#: Worker-output signatures of a coordinator bind/connect FAILURE. Failure
+#: phrases only — benign progress lines ("Connecting to JAX distributed
+#: service ...", "coordination service started") must NOT match, or a
+#: verbose script failing fast for its own reasons would be re-run anyway.
+#: Ordinary user failures (ImportError, assertions) match none of these.
+_COORDINATOR_ERROR_RE = re.compile(
+    r"address already in use"
+    r"|failed to bind"
+    r"|error starting coordination service"
+    r"|coordination service[^\n]*(?:error|fail|unavailable)"
+    r"|(?:unable to|failed to|cannot|can'?t|couldn'?t) connect[^\n]*coordinat"
+    r"|coordinat[^\n]*(?:unavailable|unreachable|timed? ?out|refused)"
+    r"|connection refused[^\n]*coordinat"
+    r"|DEADLINE_EXCEEDED[^\n]*coordinat",
+    re.IGNORECASE,
+)
 
-def _stream(proc: subprocess.Popen, rank: int) -> None:
+
+def _stream(proc: subprocess.Popen, rank: int,
+            coord_error: threading.Event) -> None:
     for line in proc.stdout:
-        sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
+        text = line.decode(errors="replace")
+        if not coord_error.is_set() and _COORDINATOR_ERROR_RE.search(text):
+            coord_error.set()
+        sys.stdout.write(f"[rank {rank}] {text}")
         sys.stdout.flush()
 
 
@@ -69,25 +94,31 @@ def main(argv=None) -> int:
     for attempt in range(_MAX_PORT_RETRIES + 1):
         port = args.coordinator_port or _free_port()
         started = time.monotonic()
-        rc = _run_once(args, port)
+        rc, coord_error = _run_once(args, port)
         fast_failure = rc != 0 and time.monotonic() - started < _STARTUP_WINDOW_S
         if rc == 128 + signal.SIGINT or rc < 0:
             # User interrupt / signal-killed worker (segfault, OOM kill):
             # never a coordinator-port race — don't re-run.
             break
-        if rc == 0 or args.coordinator_port or not fast_failure:
+        if rc == 0 or args.coordinator_port or not fast_failure or not coord_error:
+            # Re-running is only safe when the failure is OURS: a fast exit
+            # WITH a coordinator bind/connect signature in the output. A
+            # deterministic user failure (import error, assertion) must not
+            # be executed again — it would repeat its side effects.
             break
         if attempt < _MAX_PORT_RETRIES:
             sys.stderr.write(
-                f"launch: workers failed within {_STARTUP_WINDOW_S:.0f}s "
-                f"(possible port {port} race) — retrying on a new port\n"
+                f"launch: coordinator startup failure on port {port} "
+                f"within {_STARTUP_WINDOW_S:.0f}s — retrying on a new port\n"
             )
     return rc
 
 
-def _run_once(args, port: int) -> int:
+def _run_once(args, port: int) -> tuple[int, bool]:
+    """Returns (exit code, saw-coordinator-error-signature)."""
     procs: list[subprocess.Popen] = []
     threads = []
+    coord_error = threading.Event()
     rc = 0
     try:
         # Spawn INSIDE the try: a failed fork at rank k must still tear
@@ -108,7 +139,7 @@ def _run_once(args, port: int) -> int:
             )
             procs.append(proc)
             thread = threading.Thread(
-                target=_stream, args=(proc, rank), daemon=True
+                target=_stream, args=(proc, rank, coord_error), daemon=True
             )
             thread.start()
             threads.append(thread)
@@ -144,7 +175,7 @@ def _run_once(args, port: int) -> int:
                 proc.kill()
         for thread in threads:
             thread.join(timeout=2)
-    return rc
+    return rc, coord_error.is_set()
 
 
 if __name__ == "__main__":

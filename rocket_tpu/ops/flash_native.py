@@ -31,10 +31,17 @@ This kernel consumes the projection output's OWN layout:
   feature block (``kv_block`` heads per grid step);
 * same numerics as the first-generation kernel: base-2 online softmax, f32
   statistics/accumulators over bf16 operands, causal masking only on
-  diagonal blocks, one-pass fused backward with dk/dv accumulated in f32
-  scratch across the query sweep and dq written as per-kv-block partials
-  summed by one XLA add outside (O(nk) x dq HBM — documented trade; very
-  long single-device sequences should shard T via ring attention instead).
+  diagonal blocks;
+* backward has two strategies, selected by kv-block count ``nk``. Default:
+  one fused pass with dk/dv accumulated in f32 scratch across the query
+  sweep and dq written as per-kv-block f32 partials summed by one XLA add
+  outside (f32 per the round-3 advisor — a bf16 partial would round before
+  the sum, with error growing in nk). At nk >= ``_DQ_SPLIT_MIN_NK`` the
+  O(nk) x dq partial buffer is a multi-GB HBM allocation, so dq moves to
+  its own kernel with the transposed sweep (ik innermost) accumulating in
+  f32 scratch — linear HBM, at the price of recomputing the score matmuls
+  (7 vs 5 backward matmuls; measured ~9% slower attention-bwd at T=8192,
+  faster only in memory terms — numbers at ``_DQ_SPLIT_MIN_NK`` below).
 
 The reference framework has no attention code (SURVEY §0); this op backs
 the north-star transformer configs (BASELINE.json configs[2,4]).
@@ -256,8 +263,14 @@ def _fwd(q_arr, k_arr, v_arr, *, h, h_kv, d, kb, q_off, k_off, v_off,
 
 
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                kb, g, d, scale, scale2, causal):
+                *refs, kb, g, d, scale, scale2, causal, with_dq):
+    """dk/dv sweep (iq innermost). With ``with_dq`` it also emits per-kv-
+    block dq partials (f32, summed by one XLA add outside) — the fused
+    one-pass strategy for small nk."""
+    if with_dq:
+        dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -296,11 +309,14 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     ds_c, q, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )  # (bk, d)
-                # This kv block's dq contribution — summed outside.
-                dqp_ref[0, 0, :, row * d:(row + 1) * d] = jax.lax.dot_general(
-                    ds_c, k, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ).astype(dqp_ref.dtype)  # (bq, d)
+                if with_dq:
+                    # This kv block's dq contribution — summed outside.
+                    dqp_ref[0, 0, :, row * d:(row + 1) * d] = (
+                        jax.lax.dot_general(
+                            ds_c, k, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        )
+                    )  # (bq, d), f32
 
     if causal:
         @pl.when(ik < iq)
@@ -311,9 +327,10 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         def _diagonal():
             tile(masked=True)
 
-        @pl.when(ik > iq)
-        def _skipped():
-            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+        if with_dq:
+            @pl.when(ik > iq)
+            def _skipped():
+                dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
     else:
         tile(masked=False)
 
@@ -323,8 +340,77 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, kb, g, d, scale, scale2, causal):
+    """Accumulating dq sweep (ik innermost): recomputes the score and dp
+    matmuls but writes dq ONCE per q block from f32 scratch — HBM linear in
+    T where the partial strategy's O(nk) x dq buffer is quadratic."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def tile(masked: bool):
+        for jk in range(kb):
+            k = k_ref[0, :, jk * d:(jk + 1) * d]  # (bk, d)
+            v = v_ref[0, :, jk * d:(jk + 1) * d]
+            for jq in range(g):
+                row = jk * g + jq
+                q = q_ref[0, :, row * d:(row + 1) * d]  # (bq, d)
+                do = do_ref[0, :, row * d:(row + 1) * d]  # (bq, d)
+                s2t = jax.lax.dot_general(
+                    k, q, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale2  # (bk, bq)
+                if masked:
+                    s2t = _causal_mask_t(s2t)
+                pt = jnp.exp2(s2t - lse_ref[0, 0, row:row + 1])  # (bk, bq)
+                dpt = jax.lax.dot_general(
+                    v, do, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (bk, bq)
+                ds_t = pt * (dpt - delta_ref[0, 0, row:row + 1]) * scale
+                ds_c = ds_t.astype(q.dtype)
+                dq_acc[:, row * d:(row + 1) * d] += jax.lax.dot_general(
+                    ds_c, k, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (bq, d)
+
+    if causal:
+        @pl.when(ik < iq)
+        def _interior():
+            tile(masked=False)
+
+        @pl.when(ik == iq)
+        def _diagonal():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+#: kv-block count at which the backward switches from the fused one-pass
+#: kernel (dq as O(nk) x dq f32 partials summed outside — quadratic HBM in
+#: T) to the split accumulating dq kernel (linear HBM, ~2 extra score
+#: matmuls). Chip A/B (GPT-2 dims, block 512): partials win at EVERY
+#: measured length — T=1024/nk=2: 125.8k vs 121.2k tok/s full-model;
+#: T=4096/nk=8: 6.7 vs 6.8 ms; T=8192/nk=16: 8.5 vs 9.3 ms attention-only
+#: — the split's recomputed score matmuls cost more than the partial
+#: traffic. The split is kept as the MEMORY guard: at nk=16 the f32
+#: partial buffer is nk*B*T*F*4B (~3 GB at B=8, T=8192), so past this
+#: threshold the ~9% attention-bwd premium buys back that allocation.
+#: Ring attention remains the real long-T answer (docs/performance.md).
+_DQ_SPLIT_MIN_NK = 16
+
+
 def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
-                q_off, k_off, v_off, causal, block_q, block_k, interpret):
+                q_off, k_off, v_off, causal, block_q, block_k, interpret,
+                dq_split=None):
     """Shared backward body -> (dq (B,T,HqD), dk (B,T,HkvD), dv)."""
     b, t, _ = q_arr.shape
     g = h // h_kv
@@ -332,6 +418,8 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
     scale2 = _LOG2E / math.sqrt(d)
     nq, nk = t // block_q, t // block_k
     qw, kw = kb * g * d, kb * d
+    if dq_split is None:
+        dq_split = nk >= _DQ_SPLIT_MIN_NK
 
     # delta = rowsum(dout * out) per head, in lse's blocked head layout.
     delta = jnp.swapaxes(
@@ -344,6 +432,11 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
         1, 2,
     ).reshape(b, h // (kb * g), kb * g, t)
 
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+    # dk/dv (+ dq partials when fused): grid (b, hh, ik, iq), iq innermost.
     qs = pl.BlockSpec(
         (1, block_q, qw), lambda b, hh, ik, iq: (b, iq, q_off // qw + hh)
     )
@@ -353,54 +446,106 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
     vs = pl.BlockSpec(
         (1, block_k, kw), lambda b, hh, ik, iq: (b, ik, v_off // kw + hh)
     )
-
-    dq_part, dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_kernel, kb=kb, g=g, d=d, scale=scale, scale2=scale2,
-            causal=causal,
+    in_specs = [
+        qs, ks, vs,
+        pl.BlockSpec(
+            (1, block_q, qw), lambda b, hh, ik, iq: (b, iq, hh)
         ),
+        pl.BlockSpec(
+            (1, 1, kb * g, block_q), lambda b, hh, ik, iq: (b, hh, 0, iq)
+        ),
+        pl.BlockSpec(
+            (1, 1, kb * g, block_q), lambda b, hh, ik, iq: (b, hh, 0, iq)
+        ),
+    ]
+    kv_specs = [
+        pl.BlockSpec((1, block_k, kw), lambda b, hh, ik, iq: (b, ik, hh)),
+        pl.BlockSpec((1, block_k, kw), lambda b, hh, ik, iq: (b, ik, hh)),
+    ]
+    kv_shapes = [
+        jax.ShapeDtypeStruct((b, t, h_kv * d), q_arr.dtype),
+        jax.ShapeDtypeStruct((b, t, h_kv * d), q_arr.dtype),
+    ]
+    dqp_spec = pl.BlockSpec(
+        (1, 1, block_q, qw), lambda b, hh, ik, iq: (ik, b, iq, hh)
+    )
+    kernel = functools.partial(
+        _bwd_kernel, kb=kb, g=g, d=d, scale=scale, scale2=scale2,
+        causal=causal, with_dq=not dq_split,
+    )
+    outs = pl.pallas_call(
+        kernel,
         grid=(b, h_kv // kb, nk, nq),
-        in_specs=[
-            qs, ks, vs,
-            pl.BlockSpec(
-                (1, block_q, qw), lambda b, hh, ik, iq: (b, iq, hh)
-            ),
-            pl.BlockSpec(
-                (1, 1, kb * g, block_q), lambda b, hh, ik, iq: (b, hh, 0, iq)
-            ),
-            pl.BlockSpec(
-                (1, 1, kb * g, block_q), lambda b, hh, ik, iq: (b, hh, 0, iq)
-            ),
-        ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, qw), lambda b, hh, ik, iq: (ik, b, iq, hh)
-            ),
-            pl.BlockSpec(
-                (1, block_k, kw), lambda b, hh, ik, iq: (b, ik, hh)
-            ),
-            pl.BlockSpec(
-                (1, block_k, kw), lambda b, hh, ik, iq: (b, ik, hh)
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((nk, b, t, h * d), q_arr.dtype),
-            jax.ShapeDtypeStruct((b, t, h_kv * d), q_arr.dtype),
-            jax.ShapeDtypeStruct((b, t, h_kv * d), q_arr.dtype),
-        ],
+        in_specs=in_specs,
+        out_specs=([] if dq_split else [dqp_spec]) + kv_specs,
+        out_shape=(
+            [] if dq_split
+            # f32 partials: a bf16 partial would round BEFORE the outer
+            # sum, with dq error growing in nk (round-3 advisor finding);
+            # dk/dv already accumulate in f32 scratch.
+            else [jax.ShapeDtypeStruct((nk, b, t, h * d), jnp.float32)]
+        ) + kv_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_k, kw), jnp.float32),
             pltpu.VMEM((block_k, kw), jnp.float32),
         ],
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
+        compiler_params=compiler_params,
         interpret=interpret,
     )(q_arr, k_arr, v_arr, dout, lse, delta)
 
-    dq = dq_part[0] if nk == 1 else jnp.sum(
-        dq_part.astype(jnp.float32), axis=0
-    ).astype(q_arr.dtype)
+    if dq_split:
+        dk, dv = outs
+        # dq: grid (b, hh, iq, ik), ik innermost — accumulate in scratch,
+        # one write per q block.
+        dq, = pl.pallas_call(
+            functools.partial(
+                _dq_kernel, kb=kb, g=g, d=d, scale=scale, scale2=scale2,
+                causal=causal,
+            ),
+            grid=(b, h_kv // kb, nq, nk),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q, qw),
+                    lambda b, hh, iq, ik: (b, iq, q_off // qw + hh),
+                ),
+                pl.BlockSpec(
+                    (1, block_k, kw),
+                    lambda b, hh, iq, ik: (b, ik, k_off // kw + hh),
+                ),
+                pl.BlockSpec(
+                    (1, block_k, kw),
+                    lambda b, hh, iq, ik: (b, ik, v_off // kw + hh),
+                ),
+                pl.BlockSpec(
+                    (1, block_q, qw), lambda b, hh, iq, ik: (b, iq, hh)
+                ),
+                pl.BlockSpec(
+                    (1, 1, kb * g, block_q),
+                    lambda b, hh, iq, ik: (b, hh, 0, iq),
+                ),
+                pl.BlockSpec(
+                    (1, 1, kb * g, block_q),
+                    lambda b, hh, iq, ik: (b, hh, 0, iq),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, block_q, qw), lambda b, hh, iq, ik: (b, iq, hh)
+                ),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, t, h * d), q_arr.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_q, qw), jnp.float32)],
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(q_arr, k_arr, v_arr, dout, lse, delta)
+        return dq, dk, dv
+
+    dq_part, dk, dv = outs
+    dq = (dq_part[0] if nk == 1 else jnp.sum(dq_part, axis=0)).astype(
+        q_arr.dtype
+    )
     return dq, dk, dv
 
 
@@ -422,8 +567,8 @@ def _resolve_blocks(t: int, causal: bool, block_q: int, block_k: int):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
-def _flash_fused(fused, h, d, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _flash_fused(fused, h, d, causal, block_q, block_k, interpret, dq_split):
     out, _ = _fwd(
         fused, fused, fused, h=h, h_kv=h, d=d, kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
@@ -432,7 +577,8 @@ def _flash_fused(fused, h, d, causal, block_q, block_k, interpret):
     return out
 
 
-def _flash_fused_fwd(fused, h, d, causal, block_q, block_k, interpret):
+def _flash_fused_fwd(fused, h, d, causal, block_q, block_k, interpret,
+                     dq_split):
     out, lse = _fwd(
         fused, fused, fused, h=h, h_kv=h, d=d, kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
@@ -441,13 +587,15 @@ def _flash_fused_fwd(fused, h, d, causal, block_q, block_k, interpret):
     return out, (fused, out, lse)
 
 
-def _flash_fused_bwd(h, d, causal, block_q, block_k, interpret, res, dout):
+def _flash_fused_bwd(h, d, causal, block_q, block_k, interpret, dq_split,
+                     res, dout):
     fused, out, lse = res
     dq, dk, dv = _bwd_arrays(
         fused, fused, fused, out, lse, dout, h=h, h_kv=h, d=d,
         kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        dq_split=dq_split,
     )
     return (jnp.concatenate([dq, dk, dv], axis=-1),)
 
@@ -462,6 +610,7 @@ def flash_fused(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    dq_split: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention directly on the fused QKV projection output.
 
@@ -471,6 +620,12 @@ def flash_fused(
     the ONE operand. Returns (B, T, H*D), ready for the output projection.
     Differentiable (custom VJP, one-pass fused backward producing the
     (B, T, 3*H*D) cotangent).
+
+    ``dq_split``: backward dq strategy — None (default) picks by kv-block
+    count (``_DQ_SPLIT_MIN_NK``); False forces the fused f32-partials pass
+    (fastest, O(nk) x dq HBM); True forces the separate accumulating dq
+    kernel (linear HBM, ~9% slower attention-bwd — the memory-bound
+    escape below the automatic threshold).
     """
     b, t, f = fused.shape
     if f % (3 * num_heads):
@@ -489,10 +644,10 @@ def flash_fused(
         return flash_bthd(
             fused[..., :hd], fused[..., hd:2 * hd], fused[..., 2 * hd:],
             num_heads, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, dq_split=dq_split,
         )
     return _flash_fused(
-        fused, num_heads, d, causal, block_q, block_k, interpret
+        fused, num_heads, d, causal, block_q, block_k, interpret, dq_split
     )
 
 
@@ -501,8 +656,8 @@ def flash_fused(
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bthd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_bthd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret, dq_split):
     kb = _kv_block(h_kv, h // h_kv, d, h * d, h_kv * d)
     out, _ = _fwd(
         q2, k2, v2, h=h, h_kv=h_kv, d=d, kb=kb,
@@ -513,7 +668,8 @@ def _flash_bthd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret):
     return out
 
 
-def _flash_bthd_fwd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret):
+def _flash_bthd_fwd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret,
+                    dq_split):
     kb = _kv_block(h_kv, h // h_kv, d, h * d, h_kv * d)
     out, lse = _fwd(
         q2, k2, v2, h=h, h_kv=h_kv, d=d, kb=kb,
@@ -524,14 +680,15 @@ def _flash_bthd_fwd(q2, k2, v2, h, h_kv, d, causal, blocks, interpret):
     return out, (q2, k2, v2, out, lse)
 
 
-def _flash_bthd_bwd(h, h_kv, d, causal, blocks, interpret, res, dout):
+def _flash_bthd_bwd(h, h_kv, d, causal, blocks, interpret, dq_split,
+                    res, dout):
     q2, k2, v2, out, lse = res
     kb = _kv_block(h_kv, h // h_kv, d, h * d, h_kv * d)
     return _bwd_arrays(
         q2, k2, v2, out, lse, dout, h=h, h_kv=h_kv, d=d, kb=kb,
         q_off=0, k_off=0, v_off=0,
         causal=causal, block_q=blocks[0], block_k=blocks[1],
-        interpret=interpret,
+        interpret=interpret, dq_split=dq_split,
     )
 
 
@@ -548,6 +705,7 @@ def flash_bthd(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    dq_split: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention on feature-major (B, T, H*D) operands.
 
@@ -557,6 +715,7 @@ def flash_bthd(
     path repeated K/V to full heads, materializing the 4x traffic GQA
     exists to avoid). Also the layout RoPE emits (rotation on (B, T, H, D)
     then a free trailing-dim merge). Returns (B, T, Hq*D).
+    ``dq_split``: backward dq strategy override — see :func:`flash_fused`.
     """
     if num_kv_heads is None:
         num_kv_heads = num_heads
@@ -576,7 +735,7 @@ def flash_bthd(
         interpret = _interpret_default()
     return _flash_bthd(
         q2, k2, v2, num_heads, num_kv_heads, d, causal,
-        (block_q, block_k), interpret,
+        (block_q, block_k), interpret, dq_split,
     )
 
 

@@ -43,6 +43,7 @@ class IdentityRegistry:
     def __init__(self, kind: str) -> None:
         self._kind = kind
         self._entries: dict[int, tuple[Any, Any]] = {}  # id -> (raw, prepared)
+        self._refs: dict[Any, int] = {}  # optional holder counts (retain/release)
 
     def lookup(self, raw: Any, extra_key: Any = None) -> Optional[Any]:
         entry = self._entries.get((id(raw), extra_key))
@@ -59,7 +60,31 @@ class IdentityRegistry:
         return prepared
 
     def remove(self, raw: Any, extra_key: Any = None) -> None:
-        self._entries.pop((id(raw), extra_key), None)
+        key = (id(raw), extra_key)
+        self._entries.pop(key, None)
+        self._refs.pop(key, None)
+
+    def retain(self, raw: Any, extra_key: Any = None) -> None:
+        """Count a holder of an existing entry. Entries with holders are
+        only truly released when the LAST holder calls :meth:`release` —
+        two Dataset capsules sharing one prepared loader must not have its
+        worker pool shut down when the first capsule is destroyed (round-3
+        advisor finding)."""
+        key = (id(raw), extra_key)
+        self._refs[key] = self._refs.get(key, 0) + 1
+
+    def release(self, raw: Any, extra_key: Any = None) -> bool:
+        """Drop one holder; returns True when this was the last one (the
+        entry is then removed and the caller owns teardown). Entries never
+        retained release immediately."""
+        key = (id(raw), extra_key)
+        count = self._refs.get(key, 1) - 1
+        if count > 0:
+            self._refs[key] = count
+            return False
+        self._refs.pop(key, None)
+        self._entries.pop(key, None)
+        return True
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -72,6 +72,71 @@ def test_fused_grads_match_xla():
     assert jnp.max(jnp.abs(g_ref - g_fl)) < 1e-4
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("dq_split", [True, False])
+def test_fused_grads_match_xla_both_dq_strategies(dq_split, causal):
+    """The backward has two dq strategies — the fused f32-partials pass
+    (default below _DQ_SPLIT_MIN_NK=16) and the split accumulating kernel
+    (the memory-bound escape) — both must match XLA. The public dq_split
+    kwarg forces each regardless of the nk threshold (t=512 @ block 128 is
+    nk=4, which would default to partials)."""
+    b, t, h, d = 1, 512, 2, 32
+    fused = jax.random.normal(jax.random.key(5), (b, t, 3 * h * d))
+
+    def ref_loss(f):
+        q2, k2, v2 = jnp.split(f, 3, axis=-1)
+        return (
+            dot_product_attention(
+                _heads(q2, h), _heads(k2, h), _heads(v2, h), causal=causal
+            )
+            ** 2
+        ).sum()
+
+    def fl_loss(f):
+        return (
+            flash_fused(
+                f, h, causal=causal, block_q=128, block_k=128,
+                dq_split=dq_split,
+            ) ** 2
+        ).sum()
+
+    g_ref = jax.grad(ref_loss)(fused)
+    g_fl = jax.grad(fl_loss)(fused)
+    assert jnp.max(jnp.abs(g_ref - g_fl)) < 2e-4
+
+
+@pytest.mark.parametrize("dq_split", [True, False])
+def test_bthd_gqa_grads_both_dq_strategies(dq_split):
+    b, t, h, h_kv, d = 1, 512, 4, 2, 32
+    args = (
+        jax.random.normal(jax.random.key(6), (b, t, h * d)),
+        jax.random.normal(jax.random.key(7), (b, t, h_kv * d)),
+        jax.random.normal(jax.random.key(8), (b, t, h_kv * d)),
+    )
+
+    def ref_loss(q2, k2, v2):
+        return (
+            grouped_dot_product_attention(
+                _heads(q2, h), _heads(k2, h_kv), _heads(v2, h_kv), causal=True
+            )
+            ** 2
+        ).sum()
+
+    def fl_loss(q2, k2, v2):
+        return (
+            flash_bthd(
+                q2, k2, v2, h, h_kv, causal=True, block_q=128, block_k=128,
+                dq_split=dq_split,
+            )
+            ** 2
+        ).sum()
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(*args)
+    g_fl = jax.grad(fl_loss, argnums=(0, 1, 2))(*args)
+    for a, b_ in zip(g_ref, g_fl):
+        assert jnp.max(jnp.abs(a - b_)) < 2e-4
+
+
 @pytest.mark.parametrize("h,h_kv", [(6, 2), (4, 1), (4, 4)])
 def test_bthd_gqa_matches_grouped_einsum(h, h_kv):
     b, t, d = 2, 256, 32

@@ -129,3 +129,29 @@ def test_batch_cleared_each_iteration(runtime):
     looper = Looper([Spy()], tag="train", repeats=2)
     Launcher([looper], num_epochs=1, runtime=runtime).launch()
     assert batches == [None, None]
+
+
+def test_shared_loader_closed_only_by_last_holder(runtime):
+    """Two capsules deduped onto ONE prepared loader: destroying the first
+    must not shut the shared worker pool down while the second may still be
+    iterating (round-3 advisor finding)."""
+    raw = make_samples(8)
+    d1 = Dataset(raw, batch_size=4, device_cache=False, statefull=False,
+                 runtime=runtime)
+    d2 = Dataset(raw, batch_size=4, device_cache=False, statefull=False,
+                 runtime=runtime)
+    d1.setup()
+    d2.setup()
+    assert d1._dataloader is d2._dataloader
+    loader = d1._dataloader
+    closed = []
+    orig_close = loader.close
+    loader.close = lambda: (closed.append(1), orig_close())
+
+    d1.destroy()
+    assert not closed  # d2 still holds the loader
+    assert runtime.dataloaders.lookup(raw, d2._registry_key) is loader
+
+    d2.destroy()
+    assert closed  # last holder tears it down
+    assert runtime.dataloaders.lookup(raw, d2._registry_key) is None
