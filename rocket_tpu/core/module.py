@@ -430,6 +430,18 @@ class Module(Dispatcher):
         runtime = self._runtime
         accum = runtime.gradient_accumulation_steps
         forward = self._forward()
+        # Models may own their fused loss+backward (the 1F1B pipeline
+        # schedule computes grads inside ONE pipelined program —
+        # TransformerLM.pipelined_value_and_grad). None = standard path.
+        custom_vag = None
+        vag_builder = getattr(self._model, "pipelined_value_and_grad", None)
+        if vag_builder is not None:
+            custom_vag = vag_builder(objective)
+            if custom_vag is not None:
+                self.log_info(
+                    "train step: model-provided pipelined value_and_grad "
+                    "(1F1B schedule)"
+                )
         lr_fn = self._lr_fn
         return_out = self._return_outputs == "always"
         ema_decay = self._ema_decay
@@ -456,16 +468,22 @@ class Module(Dispatcher):
                     dict(batch), jax.random.fold_in(rng, 0xA9517)
                 )
 
-            def loss_fn(params):
-                out, mstate = forward(
-                    params, state["model_state"], batch, mode="train", rng=rng
+            if custom_vag is not None:
+                (loss, (out, mstate)), grads = custom_vag(
+                    state["params"], state["model_state"], batch, rng
                 )
-                loss = objective(out)
-                return loss.astype(jnp.float32), (out, mstate)
+            else:
 
-            (loss, (out, mstate)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state["params"])
+                def loss_fn(params):
+                    out, mstate = forward(
+                        params, state["model_state"], batch, mode="train", rng=rng
+                    )
+                    loss = objective(out)
+                    return loss.astype(jnp.float32), (out, mstate)
+
+                (loss, (out, mstate)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state["params"])
 
             new_state = dict(state)
             new_state["model_state"] = mstate

@@ -37,7 +37,7 @@ try:  # jax >= 0.8 moved shard_map out of experimental
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["pipeline_blocks"]
+__all__ = ["pipeline_blocks", "pipeline_train_1f1b"]
 
 #: Compiled pipelines keyed by (block_apply, mesh, schedule knobs, treedefs)
 #: — a fresh jit closure per call would retrace the whole M+P-1-tick scan on
@@ -55,6 +55,7 @@ def pipeline_blocks(
     data_axis: Optional[str] = "data",
     num_microbatches: Optional[int] = None,
     remat: bool = True,
+    remat_policy=None,
     rng: Optional[jax.Array] = None,
     with_aux: bool = False,
 ):
@@ -106,6 +107,7 @@ def pipeline_blocks(
         data_axis,
         m,
         remat,
+        remat_policy,
         num_layers,
         jax.tree.structure(stacked_params),
         rng is None,
@@ -121,6 +123,7 @@ def pipeline_blocks(
             data_axis=data_axis if data_shards > 1 else None,
             m=m,
             remat=remat,
+            remat_policy=remat_policy,
             n_stages=n_stages,
             layers_per_stage=num_layers // n_stages,
             with_aux=with_aux,
@@ -130,7 +133,7 @@ def pipeline_blocks(
 
 def _build(
     block_apply, params_treedef, *, mesh, pipe_axis, data_axis, m, remat,
-    n_stages, layers_per_stage, with_aux,
+    remat_policy, n_stages, layers_per_stage, with_aux,
 ):
     batch_spec = P(data_axis, None, None)
     param_spec = jax.tree_util.tree_unflatten(
@@ -168,7 +171,7 @@ def _build(
             return h, aux
 
         if remat:
-            run_stage = jax.checkpoint(run_stage)
+            run_stage = jax.checkpoint(run_stage, policy=remat_policy)
 
         def tick(carry, t):
             incoming, outputs, aux_acc = carry
@@ -237,4 +240,284 @@ def _build(
     )
     # jit wrapper: the remat'ed stage body can't evaluate eagerly inside
     # shard_map; under an outer jit (the normal train step) this inlines.
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B — memory-bounded schedule (round-3 verdict ask #4)
+# ---------------------------------------------------------------------------
+
+#: Compiled 1F1B pipelines, same rationale as _CACHE.
+_CACHE_1F1B: dict = {}
+
+
+def pipeline_train_1f1b(
+    block_apply: Callable,
+    stacked_params,
+    x: jax.Array,
+    tail_params,
+    tail_fn: Callable,
+    tail_args,
+    *,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = "data",
+    num_microbatches: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+):
+    """One fused forward+backward pass over the pipelined trunk with the
+    1F1B (one-forward-one-backward) schedule — per-stage live activations
+    are O(P), independent of the microbatch count M (GPipe's are O(M),
+    ``pipeline_blocks`` docstring).
+
+    Autodiff of a forward-only pipeline cannot produce 1F1B: under
+    ``jax.grad`` every microbatch's forward completes before any backward
+    starts, so all M stage inputs are live at the fwd/bwd boundary. 1F1B's
+    memory bound comes from starting microbatch i's backward while later
+    microbatches are still in forward — which requires the LOSS inside the
+    pipelined program. Hence this function computes loss AND grads itself
+    (hand-scheduled vjp), rather than being differentiated.
+
+    Schedule (lockstep SPMD, one F-slot + one B-slot per tick, ticks
+    ``t in [0, M + 2P - 2)``):
+
+    * stage ``s`` FORWARDS microbatch ``fi = t - s`` (ppermute up);
+    * the LAST stage runs ``tail_fn`` (head + loss) on its fresh forward
+      output and seeds that microbatch's backward in the same tick;
+    * stage ``s`` BACKWARDS microbatch ``bi = t - (2(P-1) - s)``
+      (cotangents ppermute down), recomputing its forward from the saved
+      stage input (= remat) via ``jax.vjp``.
+
+    A forward input saved at tick ``fi + s`` is consumed by its backward
+    at tick ``fi + 2(P-1) - s`` — a lifetime of ``2(P-1-s)`` ticks, so a
+    rotating buffer of depth ``2P - 1`` suffices for ANY M. That buffer is
+    the O(P) claim, asserted by test via compiled memory analysis.
+
+    Parameters: ``block_apply(params_i, layer_idx, mb_idx, h, rng) -> h``
+    (same contract as :func:`pipeline_blocks`, no-aux form — MoE aux is
+    not wired through 1F1B); ``tail_fn(tail_params, h_mb, tail_args_mb)
+    -> scalar mean loss for the microbatch``; ``tail_args`` a pytree with
+    leading batch dim (e.g. the target tokens). Returns ``(loss_mean,
+    stacked_param_grads, tail_grads, dx)`` where ``dx`` is the cotangent
+    w.r.t. ``x`` — backpropagate it through the embedding outside.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"pipeline_train_1f1b: {num_layers} layers must divide over "
+            f"{n_stages} stages."
+        )
+    m = num_microbatches or 2 * n_stages
+    batch = x.shape[0]
+    data_shards = (
+        mesh.shape[data_axis] if (data_axis and data_axis in mesh.shape) else 1
+    )
+    if (batch // data_shards) % m:
+        raise ValueError(
+            f"pipeline_train_1f1b: per-shard batch {batch // data_shards} "
+            f"must divide into {m} microbatches."
+        )
+
+    key = (
+        block_apply,
+        tail_fn,
+        mesh,
+        pipe_axis,
+        data_axis,
+        m,
+        num_layers,
+        jax.tree.structure(stacked_params),
+        jax.tree.structure(tail_params),
+        jax.tree.structure(tail_args),
+        rng is None,
+    )
+    fn = _CACHE_1F1B.get(key)
+    if fn is None:
+        fn = _CACHE_1F1B[key] = _build_1f1b(
+            block_apply,
+            tail_fn,
+            jax.tree.structure(stacked_params),
+            mesh=mesh,
+            pipe_axis=pipe_axis,
+            data_axis=data_axis if data_shards > 1 else None,
+            m=m,
+            n_stages=n_stages,
+            layers_per_stage=num_layers // n_stages,
+        )
+    return fn(stacked_params, x, tail_params, tail_args, rng)
+
+
+def _build_1f1b(
+    block_apply, tail_fn, params_treedef, *, mesh, pipe_axis, data_axis, m,
+    n_stages, layers_per_stage,
+):
+    batch_spec = P(data_axis, None, None)
+    param_spec = jax.tree_util.tree_unflatten(
+        params_treedef, [P(pipe_axis)] * params_treedef.num_leaves
+    )
+    depth = 2 * n_stages - 1  # rotating saved-input buffer — the O(P) bound
+    last = n_stages - 1
+
+    def stage_fn(local_params, x_local, tail_params, tail_args, rng):
+        s = jax.lax.axis_index(pipe_axis)
+        b_local = x_local.shape[0]
+        mb = b_local // m
+        micro = x_local.reshape(m, mb, *x_local.shape[1:])
+        micro_args = jax.tree.map(
+            lambda a: a.reshape(m, mb, *a.shape[1:]), tail_args
+        )
+        up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        down = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def stage_fwd(params, h, mb_idx):
+            def layer(h, xs):
+                params_i, local_i = xs
+                return block_apply(
+                    params_i, s * layers_per_stage + local_i, mb_idx, h, rng
+                ), None
+
+            h, _ = jax.lax.scan(
+                layer, h, (params, jnp.arange(layers_per_stage))
+            )
+            return h
+
+        zero_h = jnp.zeros_like(micro[0])
+        zero_pgrads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+        )
+        zero_tgrads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), tail_params
+        )
+
+        def tick(carry, t):
+            fwd_in, bwd_in, buf, pgrads, tgrads, loss_acc, dx_buf = carry
+
+            # ---- forward slot -------------------------------------------
+            fi = t - s
+            f_valid = (fi >= 0) & (fi < m)
+            fi_c = jnp.clip(fi, 0, m - 1)
+            h_in = jnp.where(s == 0, micro[fi_c], fwd_in)
+            slot = fi_c % depth
+            buf = buf.at[slot].set(jnp.where(f_valid, h_in, buf[slot]))
+            y = jax.lax.cond(
+                f_valid,
+                lambda h: stage_fwd(local_params, h, fi_c),
+                lambda h: h,
+                h_in,
+            )
+
+            # ---- loss tail on the last stage (same tick as its F) -------
+            def run_tail(operand):
+                tp, h, args_mb = operand
+                loss_mb, tail_vjp = jax.vjp(
+                    lambda tp_, h_: tail_fn(tp_, h_, args_mb), tp, h
+                )
+                dtp, dh = tail_vjp(jnp.full((), 1.0 / m, jnp.float32))
+                return loss_mb, dtp, dh
+
+            def skip_tail(operand):
+                tp, h, _ = operand
+                return (
+                    jnp.zeros((), jnp.float32),
+                    jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), tp
+                    ),
+                    jnp.zeros_like(h),
+                )
+
+            tail_live = f_valid & (s == last)
+            loss_mb, dtp, dh_tail = jax.lax.cond(
+                tail_live, run_tail, skip_tail,
+                (tail_params, y, jax.tree.map(lambda a: a[fi_c], micro_args)),
+            )
+            loss_acc = loss_acc + loss_mb
+            tgrads = jax.tree.map(jnp.add, tgrads, dtp)
+
+            # ---- backward slot ------------------------------------------
+            bi = t - (2 * (n_stages - 1) - s)
+            b_valid = (bi >= 0) & (bi < m)
+            bi_c = jnp.clip(bi, 0, m - 1)
+            # Last stage: bi == fi, so the cotangent is THIS tick's tail
+            # output; other stages receive it from downstream.
+            g_in = jnp.where(s == last, dh_tail, bwd_in)
+            h_saved = buf[bi_c % depth]
+
+            def run_bwd(operand):
+                h_s, g = operand
+                _, vjp_fn = jax.vjp(
+                    lambda pr, h: stage_fwd(pr, h, bi_c), local_params, h_s
+                )
+                dp, dh_prev = vjp_fn(g.astype(h_s.dtype))
+                return (
+                    jax.tree.map(lambda a: a.astype(jnp.float32), dp),
+                    dh_prev,
+                )
+
+            def skip_bwd(operand):
+                h_s, _ = operand
+                return zero_pgrads, jnp.zeros_like(h_s)
+
+            dp, dh_prev = jax.lax.cond(b_valid, run_bwd, skip_bwd, (h_saved, g_in))
+            pgrads = jax.tree.map(jnp.add, pgrads, dp)
+            write_dx = b_valid & (s == 0)
+            dx_buf = dx_buf.at[bi_c].set(
+                jnp.where(write_dx, dh_prev, dx_buf[bi_c])
+            )
+
+            fwd_in = jax.lax.ppermute(y, pipe_axis, up)
+            bwd_in = jax.lax.ppermute(dh_prev, pipe_axis, down)
+            return (fwd_in, bwd_in, buf, pgrads, tgrads, loss_acc, dx_buf), None
+
+        vary = (pipe_axis,) + ((data_axis,) if data_axis else ())
+        carry0 = (
+            pvary_compat(zero_h, (pipe_axis,)),                       # fwd_in
+            pvary_compat(jnp.zeros_like(zero_h), (pipe_axis,)),       # bwd_in
+            pvary_compat(
+                jnp.zeros((depth, *zero_h.shape), zero_h.dtype), (pipe_axis,)
+            ),                                                        # buf
+            jax.tree.map(lambda z: pvary_compat(z, (pipe_axis,)), zero_pgrads),
+            jax.tree.map(lambda z: pvary_compat(z, vary), zero_tgrads),
+            pvary_compat(jnp.zeros((), jnp.float32), vary),           # loss
+            pvary_compat(
+                jnp.zeros((m, *zero_h.shape), zero_h.dtype), (pipe_axis,)
+            ),                                                        # dx
+        )
+        ticks = jnp.arange(m + 2 * n_stages - 2)
+        (_, _, _, pgrads, tgrads, loss_acc, dx_buf), _ = jax.lax.scan(
+            tick, carry0, ticks
+        )
+
+        # loss / tail grads live on the last stage only; dx on stage 0.
+        loss = jax.lax.psum(
+            jnp.where(s == last, loss_acc, 0.0), pipe_axis
+        ) / m
+        tgrads = jax.tree.map(
+            lambda g: jax.lax.psum(jnp.where(s == last, g, 0.0), pipe_axis),
+            tgrads,
+        )
+        dx = jax.lax.psum(
+            jnp.where(s == 0, dx_buf, jnp.zeros_like(dx_buf)), pipe_axis
+        ).reshape(b_local, *x_local.shape[1:])
+        if data_axis is not None:
+            # Per-shard loss is the mean over its stripe; the global loss
+            # (and so the grads) averages over shards. dx stays per-stripe
+            # data but needs the same 1/S from the cross-shard mean.
+            loss = jax.lax.pmean(loss, data_axis)
+            tgrads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, data_axis), tgrads
+            )
+            pgrads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, data_axis), pgrads
+            )
+            dx = dx / mesh.shape[data_axis]
+        return loss, pgrads, tgrads, dx
+
+    fn = shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(param_spec, batch_spec, P(), P(data_axis), P()),
+        out_specs=(P(), param_spec, P(), batch_spec),
+        check_vma=False,
+    )
     return jax.jit(fn)

@@ -44,7 +44,14 @@ def timeit(fn, *args, iters=10, warmup=2):
 
 
 def main():
-    B = 64
+    B = 128  # the measured throughput knee (docs/performance.md)
+    for i, a in enumerate(sys.argv):
+        if a == "--batch":
+            if i + 1 >= len(sys.argv):
+                raise SystemExit(
+                    "usage: profile_resnet50.py [--batch N] [--trace]"
+                )
+            B = int(sys.argv[i + 1])
     trace = "--trace" in sys.argv
 
     import rocket_tpu as rt

@@ -1,0 +1,101 @@
+"""Per-op roofline table from a ``jax.profiler`` trace (TPU).
+
+Each TensorCore event in the trace carries ``model_flops``,
+``bytes_accessed`` and ``hlo_category`` — enough to compute, per HLO op,
+what fraction of the MXU peak and of HBM bandwidth it achieved and which
+resource binds it. This turns "the conv path is ~25% MFU" into a table
+naming WHERE the other 75% goes (round-3 verdict ask #1).
+
+CAVEAT (SURVEY §6): summed op durations are NOT wall time — gaps between
+ops (scheduling, infeed) are invisible here. The table attributes the
+measured on-device time; the bench's wall-clock MFU is the honest
+end-to-end number.
+
+Usage: ``python scripts/trace_roofline.py <trace_dir> [--peak-tflops 197]
+[--peak-gbps 819] [--by source|category|op] [--steps N]``
+"""
+
+import glob
+import gzip
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(trace_dir):
+    files = sorted(
+        glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    )
+    if not files:
+        raise SystemExit(f"no *.trace.json.gz under {trace_dir}")
+    with gzip.open(files[-1], "rt") as f:
+        return json.load(f).get("traceEvents", [])
+
+
+def main():
+    args = sys.argv[1:]
+    trace_dir = args[0] if args and not args[0].startswith("--") else "traces"
+    peak_tflops, peak_gbps, by, steps = 197.0, 819.0, "source", 3
+    flags = {"--peak-tflops", "--peak-gbps", "--by", "--steps"}
+    for i, a in enumerate(args):
+        if a in flags and i + 1 >= len(args):
+            raise SystemExit(f"{a} needs a value")
+        if a == "--peak-tflops":
+            peak_tflops = float(args[i + 1])
+        elif a == "--peak-gbps":
+            peak_gbps = float(args[i + 1])
+        elif a == "--by":
+            by = args[i + 1]
+        elif a == "--steps":
+            steps = int(args[i + 1])
+
+    rows = defaultdict(lambda: [0.0, 0.0, 0.0, set()])  # dur, flops, bytes, cats
+    total = 0.0
+    for e in load_events(trace_dir):
+        if e.get("ph") != "X" or not e.get("dur"):
+            continue
+        a = e.get("args") or {}
+        cat = a.get("hlo_category")
+        if cat is None:
+            continue  # outer jit rows, host rows
+        dur_s = float(a.get("device_duration_ps", 0)) * 1e-12
+        if dur_s == 0:
+            continue
+        if by == "source":
+            src = a.get("source", "?")
+            key = f"{src} [{cat}]"
+        elif by == "category":
+            key = cat
+        else:
+            key = e.get("name", "?")
+        r = rows[key]
+        r[0] += dur_s
+        r[1] += float(a.get("model_flops", 0) or 0)
+        r[2] += float(a.get("bytes_accessed", 0) or 0)
+        r[3].add(cat)
+        total += dur_s
+
+    print(
+        f"{'time/step':>10} {'%step':>6} {'TFLOP/s':>8} {'%MXU':>6} "
+        f"{'GB/s':>7} {'%HBM':>6}  binder  key"
+    )
+    for key, (dur, flops, nbytes, cats) in sorted(
+        rows.items(), key=lambda kv: -kv[1][0]
+    )[:25]:
+        tf = flops / dur / 1e12
+        gbs = nbytes / dur / 1e9
+        mxu = tf / peak_tflops
+        hbm = gbs / peak_gbps
+        binder = "MXU" if mxu >= hbm else "HBM"
+        if max(mxu, hbm) < 0.15:
+            binder = "neither(!)"
+        print(
+            f"{dur / steps * 1e3:9.2f}ms {dur / total:6.1%} {tf:8.1f} "
+            f"{mxu:6.1%} {gbs:7.0f} {hbm:6.1%}  {binder:10s}  {key[:90]}"
+        )
+    print(f"\nsummed device time/step: {total / steps * 1e3:.1f} ms "
+          f"(over {steps} steps; gaps not included)")
+
+
+if __name__ == "__main__":
+    main()
