@@ -45,6 +45,7 @@ class Dataset(Capsule):
         collate_fn: Optional[Callable] = None,
         device_placement: Optional[bool] = None,
         device_cache: str | bool = "auto",
+        cache_dtype=None,
         fuse_gather: bool = True,
         num_workers: int = 0,
         worker_start_method: str = "fork",
@@ -79,6 +80,16 @@ class Dataset(Capsule):
         # the runtime's HBM budget, eliminating per-step H2D traffic (the
         # dominant cost on TPU for small datasets — see data/device_cache.py).
         self._device_cache = device_cache
+        # cache_dtype (e.g. "bfloat16"): store float leaves of the device
+        # cache at the compute precision — halves cache HBM + per-step
+        # gather traffic when the model computes in bf16 anyway. Normalized
+        # here so jnp.bfloat16 / "bfloat16" / jnp.dtype("bfloat16") all
+        # produce ONE cache-store and registry key.
+        if cache_dtype is not None:
+            import jax.numpy as jnp
+
+            cache_dtype = jnp.dtype(cache_dtype)
+        self._cache_dtype = cache_dtype
         # Fused gather (cached path): attrs.batch is a gather MARKER that
         # the Module materializes inside its compiled step — one device
         # dispatch per step instead of two. Set False if a non-Module
@@ -107,6 +118,7 @@ class Dataset(Capsule):
             self._loader_kwargs["num_workers"],
             self._loader_kwargs["worker_start_method"],
             self._fuse_gather,
+            str(self._cache_dtype),
         )
         prepared = runtime.dataloaders.lookup(self._raw_dataset, self._registry_key)
         if prepared is None:
@@ -125,10 +137,12 @@ class Dataset(Capsule):
         if runtime.process_count > 1:
             self._device_cache = False
         if self._device_cache in ("auto", True):
-            # One device-resident copy per raw dataset, shared by every
-            # loader over it (train shuffled + eval sequential upload once).
+            # One device-resident copy per (raw dataset, cache dtype),
+            # shared by every loader over it (train shuffled + eval
+            # sequential upload once).
             store = runtime.device_cache_store
-            data = store.get(id(self._raw_dataset))
+            store_key = (id(self._raw_dataset), str(self._cache_dtype))
+            data = store.get(store_key)
             if data is None:
                 data = self._materialize(runtime)
             if data is not None:
@@ -144,8 +158,9 @@ class Dataset(Capsule):
                         drop_last=self._loader_kwargs["drop_last"],
                         seed=runtime.seed,
                         fused=self._fuse_gather,
+                        cache_dtype=self._cache_dtype,
                     )
-                    store[id(self._raw_dataset)] = loader.cache
+                    store[store_key] = loader.cache
                     return loader
         return DataLoader(
             self._raw_dataset,

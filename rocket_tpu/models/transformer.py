@@ -369,7 +369,7 @@ class Block(Layer):
         aux = None
         if self.moe is not None:
             h, moe_out = self.moe.apply({"params": p["moe"], "state": {}}, h)
-            aux = moe_out["aux_loss"]
+            aux = moe_out
         else:
             h = self._mlp(p["mlp"], h)
         h = checkpoint_name(h, "mlp_out")
@@ -380,7 +380,8 @@ class Block(Layer):
             # contract keeps real state flowing; TransformerLM pops this
             # transient before anything could persist it.
             out_state = dict(variables["state"])
-            out_state["aux_loss"] = aux
+            out_state["aux_loss"] = aux["aux_loss"]
+            out_state["frac_dropped"] = aux["frac_dropped"]
             return x + h, out_state
         return x + h, variables["state"]
 
@@ -444,6 +445,11 @@ class TransformerLM(Model):
         self.logits_key = logits_key
         self._pipe_mesh = None  # pinned at first pipelined trace
         self._pipe_block_apply: dict = {}  # mode -> stable pipeline body
+        #: objective -> built 1F1B value_and_grad. The tail_fn closure keys
+        #: the compiled-pipeline cache (_CACHE_1F1B), so rebuilding it per
+        #: call would recompile the whole pipelined program each time a
+        #: train step is (re)built.
+        self._pipe_vag: dict = {}
 
     def init(self, key: jax.Array) -> Variables:
         keys = jax.random.split(key, len(self.blocks) + 3)
@@ -625,6 +631,9 @@ class TransformerLM(Model):
         c = self.config
         if not c.pipeline_axis or c.pipeline_schedule != "1f1b":
             return None
+        cached = self._pipe_vag.get(objective)
+        if cached is not None:
+            return cached
         from rocket_tpu.parallel.pipeline import pipeline_train_1f1b
 
         tied = self.head is None
@@ -715,6 +724,7 @@ class TransformerLM(Model):
             out["nll"] = loss  # for the Loss capsule's running value
             return (loss, (out, model_state)), grads
 
+        self._pipe_vag[objective] = vag
         return vag
 
     def apply(self, variables, batch, *, mode="train", rng=None):
@@ -742,7 +752,12 @@ class TransformerLM(Model):
 
         moe = self.config.num_experts > 0
         aux_total = jnp.zeros((), jnp.float32) if moe else None
+        # Mean dropped-routing fraction across layers (capacity-utilization
+        # metric); the pipelined aux channel carries only the loss scalar,
+        # so it stays None there.
+        dropped_total = jnp.zeros((), jnp.float32) if moe else None
         if self.config.pipeline_axis:
+            dropped_total = None
             if moe:
                 x, aux_total = self._apply_pipelined(p, x, mode=mode, rng=rng)
             else:
@@ -752,20 +767,21 @@ class TransformerLM(Model):
 
             def body(carry, xs):
                 params_i, i = xs
-                h, aux = carry
+                h, aux, dropped = carry
                 y, bstate = block.apply(
                     {"params": params_i, "state": {}}, h,
                     mode=mode, rng=rng, layer_idx=i,
                 )
                 if moe:
                     aux = aux + bstate["aux_loss"]
-                return (y, aux), None
+                    dropped = dropped + bstate["frac_dropped"]
+                return (y, aux, dropped), None
 
             if self.config.scan_remat:
                 body = jax.checkpoint(body, policy=self.config.remat_policy())
-            (x, aux_total), _ = jax.lax.scan(
+            (x, aux_total, dropped_total), _ = jax.lax.scan(
                 body,
-                (x, aux_total),
+                (x, aux_total, dropped_total),
                 (p["blocks_stacked"], jnp.arange(self.config.num_layers)),
                 unroll=self.config.scan_unroll,
             )
@@ -776,6 +792,7 @@ class TransformerLM(Model):
                 )
                 if moe:
                     aux_total = aux_total + bstate["aux_loss"]
+                    dropped_total = dropped_total + bstate["frac_dropped"]
 
         x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
         out = dict(batch)
@@ -819,6 +836,13 @@ class TransformerLM(Model):
             # Pre-weighted router load-balancing loss; next_token_loss adds
             # it when present.
             out["moe_aux_loss"] = aux_total * self.config.moe_aux_weight
+            if dropped_total is not None:
+                # Layer-mean fraction of routed (token, choice) pairs that
+                # overflowed expert capacity — track it (Meter/Tracker) to
+                # see whether the balance loss is holding.
+                out["moe_frac_dropped"] = (
+                    dropped_total / self.config.num_layers
+                )
         return out, variables["state"]
 
 

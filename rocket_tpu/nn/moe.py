@@ -168,7 +168,14 @@ class MoE(Layer):
         mean_gate = jnp.mean(gates, axis=(0, 1))
         aux = e * jnp.sum(fraction_routed * mean_gate)
 
-        return y, {"aux_loss": aux}
+        # Capacity utilization: the fraction of routed (token, choice)
+        # pairs that found an expert slot. 1 - frac_kept is the dropped
+        # fraction (those tokens ride the residual path only); sustained
+        # drops mean the balance loss isn't holding or capacity_factor is
+        # too tight. Surfaced as batch["moe_frac_dropped"].
+        frac_dropped = 1.0 - jnp.mean(keep.astype(jnp.float32))
+
+        return y, {"aux_loss": aux, "frac_dropped": frac_dropped}
 
     def __repr__(self):
         return (

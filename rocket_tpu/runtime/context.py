@@ -245,9 +245,10 @@ class Runtime:
         # Checkpoint stack (reference `_custom_objects`, capsule.py:40-46).
         self._checkpoint_stack: list[Any] = []
 
-        # Device-resident dataset caches, keyed by raw-dataset id (shared by
-        # all loaders over the same dataset — see data/device_cache.py).
-        self.device_cache_store: dict[int, Any] = {}
+        # Device-resident dataset caches, keyed by (raw-dataset id,
+        # cache dtype) — shared by all loaders over the same dataset at the
+        # same precision (see data/device_cache.py).
+        self.device_cache_store: dict = {}
 
         # Tracker backends keyed by name (reference `log_with`/`get_tracker`).
         self.trackers: dict[str, Any] = {}

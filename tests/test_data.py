@@ -257,3 +257,31 @@ def test_num_workers_per_sample_dataset_and_errors():
 
     with pytest.raises(ValueError, match="map-style"):
         DataLoader(iter(range(5)), batch_size=2, num_workers=2)
+
+
+def test_device_cache_dtype_and_store_keying():
+    """cache_dtype stores float leaves at compute precision; two Datasets
+    over the same raw data with different cache dtypes must not share one
+    cache entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocket_tpu.core.dataset import Dataset
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(seed=0)
+    raw = [
+        {"x": np.full((4,), float(i), np.float32), "y": np.int32(i)}
+        for i in range(8)
+    ]
+    d_bf16 = Dataset(raw, batch_size=4, cache_dtype=jnp.bfloat16,
+                     statefull=False, runtime=runtime)
+    d_f32 = Dataset(raw, batch_size=4, statefull=False, runtime=runtime)
+    d_bf16.setup()
+    d_f32.setup()
+    cache_bf16 = d_bf16._dataloader.cache
+    cache_f32 = d_f32._dataloader.cache
+    assert cache_bf16["x"].dtype == jnp.bfloat16
+    assert cache_bf16["y"].dtype == jnp.int32  # ints untouched
+    assert cache_f32["x"].dtype == jnp.float32
+    assert len(runtime.device_cache_store) == 2  # separate entries
