@@ -162,6 +162,16 @@ class Dataset(Capsule):
                     )
                     store[store_key] = loader.cache
                     return loader
+        if self._cache_dtype is not None:
+            # The streaming loader feeds raw host batches — the cast only
+            # exists on the device-cache path. Say so rather than silently
+            # changing input precision between single- and multi-host runs.
+            runtime.get_logger("dataset").warning(
+                "Dataset(cache_dtype=%s) has no effect on the streaming "
+                "loader path (multi-process run or device_cache disabled); "
+                "inputs stay at their source dtype.",
+                self._cache_dtype,
+            )
         return DataLoader(
             self._raw_dataset,
             seed=runtime.seed,

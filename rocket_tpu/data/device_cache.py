@@ -120,9 +120,11 @@ class DeviceCachedLoader:
         # compute path would see).
         if cache_dtype is not None:
             dt = jnp.dtype(cache_dtype)
+            # .dtype directly — jnp.asarray here would upload every host
+            # leaf to the device just to READ its dtype.
             data = jax.tree.map(
                 lambda l: l.astype(dt)
-                if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                if jnp.issubdtype(l.dtype, jnp.floating)
                 else l,
                 data,
             )

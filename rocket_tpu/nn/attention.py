@@ -467,6 +467,18 @@ class MultiHeadAttention(Layer):
 
     # -- incremental decoding ---------------------------------------------
 
+    def _use_decode_kernel(self, t_max: int, itemsize: int) -> bool:
+        """Fused decode kernel gate: accelerator platform + tileable cache
+        + VMEM-sized K/V blocks (tests force the kernel on CPU via
+        interpret mode directly)."""
+        from rocket_tpu.ops.decode_attention import decode_attention_supported
+
+        if jax.devices()[0].platform == "cpu":
+            return False
+        return decode_attention_supported(
+            t_max, self.head_dim, self.num_kv_heads, itemsize
+        )
+
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32) -> dict:
         """Empty KV cache for :meth:`apply_cached` — (B, Hkv, T_max, D)
         pair; under GQA the cache is num_heads/num_kv_heads times smaller
@@ -479,7 +491,13 @@ class MultiHeadAttention(Layer):
         [pos, pos+S) — S = prompt length for the batched prefill, S = 1 per
         token after. Attends causally over cache[: pos+S] — O(T_max) per
         step instead of recomputing the O(T^2) prefix. Returns
-        (out, new_cache)."""
+        (out, new_cache).
+
+        S = 1 steps on an accelerator run through the fused pallas decode
+        kernel (``ops/decode_attention.py``): cache row write + masked
+        attention in ONE kernel instead of ~8 — decode throughput is
+        launch-count-bound (docs/performance.md). Prefill (S > 1) and CPU
+        keep the einsum path."""
         b, s, _ = x.shape
         fused, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
         q, k, v = self._split_heads(fused, b, s)
@@ -488,6 +506,21 @@ class MultiHeadAttention(Layer):
             # rotated, so earlier entries never need re-rotation.
             q = apply_rope(q, pos, self.rope_base)
             k = apply_rope(k, pos, self.rope_base)
+
+        if s == 1 and self._use_decode_kernel(
+            cache["k"].shape[2], cache["k"].dtype.itemsize
+        ):
+            from rocket_tpu.ops.decode_attention import decode_attention
+
+            out3, k_cache, v_cache = decode_attention(
+                q[:, :, 0, :],
+                k[:, :, 0, :].astype(cache["k"].dtype),
+                v[:, :, 0, :].astype(cache["v"].dtype),
+                cache["k"], cache["v"], pos,
+            )
+            out = out3.reshape(b, 1, self.features)
+            out, _ = self.proj.apply({"params": params["proj"], "state": {}}, out)
+            return out, {"k": k_cache, "v": v_cache}
 
         k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))

@@ -1,0 +1,188 @@
+"""Fused single-token decode attention — one pallas kernel per layer.
+
+The decode profile (docs/performance.md, Decode section) showed per-token
+time bound by kernel-launch granularity: an S=1 decode step is ~14 tiny
+XLA kernels per layer (two cache row updates, the logits einsum, masked
+softmax chain, the combine einsum, reshapes), and ~48% of loop time was
+per-iteration sequencing overhead. This kernel collapses the attention
+part — cache row write + q.K^T + masked softmax + combine, GQA-native —
+into ONE pallas call per layer:
+
+* caches keep their ``(B, Hkv, T_max, D)`` layout (D is the whole minor
+  dim, so blocks are Mosaic-legal at any D); the new K/V rows are written
+  IN PLACE via ``input_output_aliasing`` with a scalar-prefetched dynamic
+  block index (the written block is ``(1, Hkv, 1, D)`` at row ``pos`` —
+  the rest of the cache passes through untouched);
+* the current token's self-attention term is computed directly from
+  ``k_new``/``v_new`` (the kernel never needs to re-read what it just
+  wrote); cache rows are masked to ``< pos``, so left-padded/garbage rows
+  beyond the valid prefix never contribute;
+* grouped-query attention is native: kv head ``h`` serves its ``g =
+  Hq/Hkv`` query rows from one (T, D) cache tile (no head repeat);
+* softmax statistics in f32 over bf16 operands, same as the training
+  kernels.
+
+Inference only (no custom VJP — generation never differentiates).
+The reference framework has no decode path at all (SURVEY §0); this op
+backs ``TransformerLM.decode_step`` / ``generate``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attention", "decode_attention_supported"]
+
+_NEG_INF = -1e30
+
+
+#: VMEM budget for one grid cell's cache blocks. The kernel loads a whole
+#: (Hkv, T, D) K and V block per batch row; past this bound Mosaic would
+#: fail to allocate (v5e has ~16 MiB/core of VMEM) — callers must fall
+#: back to the einsum path.
+_VMEM_CACHE_BUDGET = 12 * 1024 * 1024
+
+
+def decode_attention_supported(
+    t_max: int, d: int, h_kv: int = 1, itemsize: int = 2
+) -> bool:
+    """Shape gate: the (T, D) cache tile must be Mosaic-tileable AND the
+    per-cell K+V cache blocks must fit the VMEM budget (long-context
+    Llama-style caches — e.g. Hkv=8, D=128, T=8192 — exceed it and must
+    use the einsum path)."""
+    if t_max % 128 != 0 or d % 8 != 0:
+        return False
+    return 2 * h_kv * t_max * d * itemsize <= _VMEM_CACHE_BUDGET
+
+
+def _kernel(pos_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref,
+            o_ref, ko_ref, vo_ref, *, h_kv, g, d, scale):
+    pos = pos_ref[0]
+    # In-place cache row write. Mosaic needs >= 8 sublanes per block, so
+    # the output block is the 8-row tile containing `pos` (ko/vo alias
+    # kc/vc and the BlockSpec maps this cell to tile pos//8): read the
+    # tile, replace row pos%8, write it back. All ops kept 2D per head —
+    # 3D broadcasts hit Mosaic's "unsupported shape cast".
+    base = (pos // 8) * 8
+    rowmask = (
+        jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) == pos % 8
+    )
+    for h in range(h_kv):
+        k_tile = kc_ref[0, h, pl.ds(base, 8), :]    # (8, D)
+        v_tile = vc_ref[0, h, pl.ds(base, 8), :]
+        ko_ref[0, h] = jnp.where(rowmask, kn_ref[0, h:h + 1, :], k_tile)
+        vo_ref[0, h] = jnp.where(rowmask, vn_ref[0, h:h + 1, :], v_tile)
+
+    t = kc_ref.shape[2]
+    for h in range(h_kv):
+        q = q_ref[0, h * g:(h + 1) * g, :]          # (g, D)
+        k = kc_ref[0, h]                            # (T, D)
+        v = vc_ref[0, h]                            # (T, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # (g, T)
+        idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < pos, s, _NEG_INF)       # only the valid prefix
+        s_self = jax.lax.dot_general(
+            q, kn_ref[0, h:h + 1, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # (g, 1)
+
+        m = jnp.maximum(jnp.max(s, axis=1, keepdims=True), s_self)  # (g, 1)
+        p = jnp.exp(s - m)                          # (g, T)
+        p_self = jnp.exp(s_self - m)                # (g, 1)
+        denom = jnp.sum(p, axis=1, keepdims=True) + p_self
+        out = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # (g, D)
+        out = out + p_self * vn_ref[0, h:h + 1, :].astype(jnp.float32)
+        o_ref[0, h * g:(h + 1) * g, :] = (out / denom).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos,
+    interpret: Optional[bool] = None,
+):
+    """One fused decode-attention step.
+
+    ``q`` (B, Hq, D); ``k_new``/``v_new`` (B, Hkv, D) — this position's
+    key/value rows (already rotated if RoPE); ``k_cache``/``v_cache``
+    (B, Hkv, T_max, D) with valid rows ``[0, pos)``; ``pos`` a traced
+    int32 scalar. Returns ``(out (B, Hq, D), k_cache', v_cache')`` with
+    row ``pos`` written — the caches are updated in place (aliased
+    buffers), matching ``dynamic_update_slice`` semantics.
+    """
+    b, hq, d = q.shape
+    h_kv, t = k_cache.shape[1], k_cache.shape[2]
+    if hq % h_kv:
+        raise ValueError(
+            f"decode_attention: Hq {hq} not a multiple of Hkv {h_kv}"
+        )
+    if not decode_attention_supported(t, d, h_kv, k_cache.dtype.itemsize):
+        raise ValueError(
+            f"decode_attention: unsupported cache shape T={t}, D={d}, "
+            f"Hkv={h_kv} (T must be a multiple of 128, D of 8, and the "
+            "per-row K+V blocks must fit the VMEM budget)."
+        )
+    g = hq // h_kv
+    scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda i, pos_ref: (i, 0, 0)),
+            pl.BlockSpec((1, h_kv, d), lambda i, pos_ref: (i, 0, 0)),
+            pl.BlockSpec((1, h_kv, d), lambda i, pos_ref: (i, 0, 0)),
+            pl.BlockSpec((1, h_kv, t, d), lambda i, pos_ref: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h_kv, t, d), lambda i, pos_ref: (i, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hq, d), lambda i, pos_ref: (i, 0, 0)),
+            # The written cache tile (8 rows containing `pos`): dynamic
+            # block index from the prefetched scalar — the rest of the
+            # cache rides the aliasing.
+            pl.BlockSpec(
+                (1, h_kv, 8, d),
+                lambda i, pos_ref: (i, 0, pos_ref[0] // 8, 0),
+            ),
+            pl.BlockSpec(
+                (1, h_kv, 8, d),
+                lambda i, pos_ref: (i, 0, pos_ref[0] // 8, 0),
+            ),
+        ],
+    )
+    out, k_out, v_out = pl.pallas_call(
+        functools.partial(_kernel, h_kv=h_kv, g=g, d=d, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # args to pallas_call are (scalars, q, k_new, v_new, k_cache,
+        # v_cache) -> operand indices 1..5; k_cache (4) aliases output 1,
+        # v_cache (5) output 2.
+        input_output_aliases={4: 1, 5: 2},
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k_new, v_new,
+      k_cache, v_cache)
+    return out, k_out, v_out

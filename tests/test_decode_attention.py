@@ -1,0 +1,109 @@
+"""Fused decode-attention kernel (ops/decode_attention.py) vs the einsum
+path, interpret mode — same kernel code the TPU compiles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocket_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_supported,
+)
+
+
+def _reference(q, kn, vn, kc, vc, pos):
+    b, hq, d = q.shape
+    h_kv, t = kc.shape[1], kc.shape[2]
+    g = hq // h_kv
+    kc = kc.at[:, :, pos, :].set(kn)
+    vc = vc.at[:, :, pos, :].set(vn)
+    q5 = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bkmd->bkgm", q5, kc.astype(jnp.float32)) / np.sqrt(d)
+    s = jnp.where((jnp.arange(t) <= pos)[None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgm,bkmd->bkgd", p, vc.astype(jnp.float32))
+    return o.reshape(b, hq, d), kc, vc
+
+
+@pytest.mark.parametrize("hq,h_kv", [(4, 4), (6, 2), (4, 1)])
+@pytest.mark.parametrize("pos", [0, 7, 8, 37, 127])  # incl. tile edges
+def test_matches_einsum_reference(hq, h_kv, pos):
+    b, t, d = 2, 128, 16
+    rng = np.random.default_rng(pos)
+    q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+    kn = jnp.asarray(rng.normal(size=(b, h_kv, d)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(b, h_kv, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(b, h_kv, t, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(b, h_kv, t, d)), jnp.float32)
+    out, ko, vo = decode_attention(q, kn, vn, kc, vc, pos, interpret=True)
+    ref_o, ref_k, ref_v = _reference(q, kn, vn, kc, vc, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(ko), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(vo), np.asarray(ref_v))
+
+
+def test_bf16_and_validation():
+    b, hq, h_kv, t, d = 1, 4, 2, 128, 16
+    rng = np.random.default_rng(0)
+    args = [
+        jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+        for shape in [
+            (b, hq, d), (b, h_kv, d), (b, h_kv, d),
+            (b, h_kv, t, d), (b, h_kv, t, d),
+        ]
+    ]
+    out, _, _ = decode_attention(*args, 5, interpret=True)
+    ref_o, _, _ = _reference(*args, 5)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_o), atol=2e-2
+    )
+
+    assert not decode_attention_supported(100, 16)  # T not 128-multiple
+    assert decode_attention_supported(256, 64)
+    # Long-context Llama-style cache blocks exceed the VMEM budget.
+    assert not decode_attention_supported(8192, 128, h_kv=8, itemsize=2)
+    with pytest.raises(ValueError, match="multiple"):
+        decode_attention(
+            args[0], args[1], args[2],
+            jnp.zeros((b, h_kv, 100, d), jnp.bfloat16),
+            jnp.zeros((b, h_kv, 100, d), jnp.bfloat16),
+            3, interpret=True,
+        )
+    with pytest.raises(ValueError, match="Hq"):
+        decode_attention(
+            jnp.zeros((b, 3, d), jnp.bfloat16), args[1], args[2],
+            args[3], args[4], 3, interpret=True,
+        )
+
+
+def test_apply_cached_kernel_path_matches_einsum(monkeypatch):
+    """MultiHeadAttention.apply_cached through the fused kernel (forced on
+    CPU via interpret) must equal the einsum path bit-for-tolerance."""
+    from rocket_tpu.nn.attention import MultiHeadAttention
+
+    mha = MultiHeadAttention(32, num_heads=4, num_kv_heads=2, rope=True)
+    params = mha.init_params(jax.random.key(0))
+    cache = mha.init_cache(2, 128)
+    x = jax.random.normal(jax.random.key(1), (2, 1, 32))
+
+    out_ref, cache_ref = mha.apply_cached(params, x, cache, 9)
+
+    monkeypatch.setattr(
+        MultiHeadAttention, "_use_decode_kernel",
+        lambda self, t, itemsize: True,
+    )
+    import rocket_tpu.ops.decode_attention as da
+
+    orig = da.decode_attention
+    monkeypatch.setattr(
+        da, "decode_attention",
+        lambda *a, **kw: orig(*a, **dict(kw, interpret=True)),
+    )
+    out_k, cache_k = mha.apply_cached(params, x, cache, 9)
+    np.testing.assert_allclose(
+        np.asarray(out_k), np.asarray(out_ref), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache_k["k"]), np.asarray(cache_ref["k"]), atol=2e-6
+    )
