@@ -357,6 +357,17 @@ def bench_llama(warmup=4, steps=15):
     return _bench_lm(config, batch=8, warmup=warmup, steps=steps, name="llama_style")
 
 
+def bench_longctx(warmup=3, steps=12):
+    """Long-context single-chip: Llama-style 124M-class at T=4096 (B=2 —
+    same tokens/step as the T=1024 config). Exercises the flash kernel's
+    long-sequence regime (nk=8 kv blocks, f32 dq partials); the
+    sequence-PARALLEL path (ring attention over a 'seq' axis) is
+    validated by dryrun_multichip — one physical chip here."""
+    config = TransformerConfig.llama_style(max_seq_len=4096)
+    return _bench_lm(config, batch=2, warmup=warmup, steps=steps,
+                     name="llama_t4096")
+
+
 def bench_moe(warmup=4, steps=15):
     """Single-chip MoE LM (GPT-2-small dims, 4 experts, top-2): routed-FFN
     throughput + MFU over ACTIVE params (round-3 verdict ask #4 — MoE was
@@ -456,6 +467,9 @@ BENCHES = {
     "resnet50": bench_resnet50,
     "mlp": bench_mlp,
     "pipeline": bench_pipeline,
+    # Last on purpose: the soft time budget must never starve the configs
+    # above, which carry round-over-round HISTORY continuity.
+    "longctx": bench_longctx,
 }
 
 
@@ -497,6 +511,7 @@ METRIC_NAMES = {
     "gpt2": "gpt2_124m_tok_per_sec_per_chip",
     "gpt2_350m": "gpt2_350m_tok_per_sec_per_chip",
     "llama": "llama_style_tok_per_sec_per_chip",
+    "longctx": "llama_t4096_tok_per_sec_per_chip",
     "moe": "moe_gpt2_e4_tok_per_sec_per_chip",
     "charlm": "charlm_tok_per_sec_per_chip",
     "resnet18": "cifar_resnet18_samples_per_sec_per_chip",

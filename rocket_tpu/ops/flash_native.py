@@ -36,12 +36,13 @@ This kernel consumes the projection output's OWN layout:
   one fused pass with dk/dv accumulated in f32 scratch across the query
   sweep and dq written as per-kv-block f32 partials summed by one XLA add
   outside (f32 per the round-3 advisor — a bf16 partial would round before
-  the sum, with error growing in nk). At nk >= ``_DQ_SPLIT_MIN_NK`` the
-  O(nk) x dq partial buffer is a multi-GB HBM allocation, so dq moves to
-  its own kernel with the transposed sweep (ik innermost) accumulating in
-  f32 scratch — linear HBM, at the price of recomputing the score matmuls
-  (7 vs 5 backward matmuls; measured ~9% slower attention-bwd at T=8192,
-  faster only in memory terms — numbers at ``_DQ_SPLIT_MIN_NK`` below).
+  the sum, with error growing in nk). When the O(nk) x dq partial buffer
+  would exceed ``_DQ_PARTIALS_MAX_BYTES`` (a multi-GB allocation at large
+  B*T), dq moves to its own kernel with the transposed sweep (ik
+  innermost) accumulating in f32 scratch — linear HBM, at the price of
+  recomputing the score matmuls (7 vs 5 backward matmuls; measured ~9%
+  slower attention-bwd at T=8192, faster only in memory terms — numbers
+  at ``_DQ_PARTIALS_MAX_BYTES`` below).
 
 The reference framework has no attention code (SURVEY §0); this op backs
 the north-star transformer configs (BASELINE.json configs[2,4]).
@@ -394,18 +395,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-#: kv-block count at which the backward switches from the fused one-pass
-#: kernel (dq as O(nk) x dq f32 partials summed outside — quadratic HBM in
-#: T) to the split accumulating dq kernel (linear HBM, ~2 extra score
-#: matmuls). Chip A/B (GPT-2 dims, block 512): partials win at EVERY
-#: measured length — T=1024/nk=2: 125.8k vs 121.2k tok/s full-model;
-#: T=4096/nk=8: 6.7 vs 6.8 ms; T=8192/nk=16: 8.5 vs 9.3 ms attention-only
-#: — the split's recomputed score matmuls cost more than the partial
-#: traffic. The split is kept as the MEMORY guard: at nk=16 the f32
-#: partial buffer is nk*B*T*F*4B (~3 GB at B=8, T=8192), so past this
-#: threshold the ~9% attention-bwd premium buys back that allocation.
-#: Ring attention remains the real long-T answer (docs/performance.md).
-_DQ_SPLIT_MIN_NK = 16
+#: Partial-buffer byte bound at which the backward switches from the
+#: fused one-pass kernel (dq as O(nk) x dq f32 partials summed outside —
+#: quadratic HBM in T) to the split accumulating dq kernel (linear HBM,
+#: ~2 extra score matmuls). Chip A/B (GPT-2 dims, block 512): partials
+#: are FASTER at every measured length — T=1024/nk=2: 125.8k vs 121.2k
+#: tok/s full-model; T=4096/nk=8: 6.7 vs 6.8 ms; T=8192/nk=16: 8.5 vs
+#: 9.3 ms attention-only (and e2e llama T=8192 B=1 measured ~5% faster
+#: on partials) — the split's recomputed score matmuls cost more than
+#: the partial traffic. The split is purely the MEMORY guard: the f32
+#: partial buffer is nk*B*T*Hq*D*4 bytes (~3 GB at B=8, T=8192, GPT-2
+#: dims); past this bound the ~9% attention-bwd premium buys back that
+#: allocation. Ring attention remains the real long-T answer
+#: (docs/performance.md).
+_DQ_PARTIALS_MAX_BYTES = 1 << 30
 
 
 def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
@@ -419,7 +422,7 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
     nq, nk = t // block_q, t // block_k
     qw, kw = kb * g * d, kb * d
     if dq_split is None:
-        dq_split = nk >= _DQ_SPLIT_MIN_NK
+        dq_split = nk * b * t * h * d * 4 > _DQ_PARTIALS_MAX_BYTES
 
     # delta = rowsum(dout * out) per head, in lse's blocked head layout.
     delta = jnp.swapaxes(
@@ -621,11 +624,11 @@ def flash_fused(
     Differentiable (custom VJP, one-pass fused backward producing the
     (B, T, 3*H*D) cotangent).
 
-    ``dq_split``: backward dq strategy — None (default) picks by kv-block
-    count (``_DQ_SPLIT_MIN_NK``); False forces the fused f32-partials pass
-    (fastest, O(nk) x dq HBM); True forces the separate accumulating dq
-    kernel (linear HBM, ~9% slower attention-bwd — the memory-bound
-    escape below the automatic threshold).
+    ``dq_split``: backward dq strategy — None (default) picks by the
+    partial-buffer footprint (``_DQ_PARTIALS_MAX_BYTES``); False forces
+    the fused f32-partials pass (fastest, O(nk) x dq HBM); True forces
+    the separate accumulating dq kernel (linear HBM, ~9% slower
+    attention-bwd — the memory-bound escape below the automatic bound).
     """
     b, t, f = fused.shape
     if f % (3 * num_heads):

@@ -76,7 +76,8 @@ def test_fused_grads_match_xla():
 @pytest.mark.parametrize("dq_split", [True, False])
 def test_fused_grads_match_xla_both_dq_strategies(dq_split, causal):
     """The backward has two dq strategies — the fused f32-partials pass
-    (default below _DQ_SPLIT_MIN_NK=16) and the split accumulating kernel
+    (default while the partial buffer fits _DQ_PARTIALS_MAX_BYTES) and
+    the split accumulating kernel
     (the memory-bound escape) — both must match XLA. The public dq_split
     kwarg forces each regardless of the nk threshold (t=512 @ block 128 is
     nk=4, which would default to partials)."""
