@@ -244,7 +244,11 @@ class Module(Dispatcher):
                 variables = jax.block_until_ready(
                     jax.jit(self._model.init)(key)
                 )
-            except Exception:  # noqa: BLE001 — init semantics over speed
+            except Exception as exc:  # noqa: BLE001 — semantics over speed
+                self.log_info(
+                    "compiled init failed (%s: %s) — falling back to eager "
+                    "init", type(exc).__name__, exc,
+                )
                 variables = self._model.init(key)
             state = {
                 "params": variables["params"],

@@ -10,10 +10,15 @@ __all__ = ["pvary_compat"]
 
 def pvary_compat(x, axes):
     """Mark ``x`` as device-varying over ``axes`` (vma typing for scan
-    carries inside shard_map). jax renamed pvary -> pcast(..., to='varying');
-    older versions only have pvary."""
+    carries inside shard_map). Idempotent: axes the value already varies
+    over are skipped (pcast rejects varying->varying). jax renamed
+    pvary -> pcast(..., to='varying'); older versions only have pvary."""
     if hasattr(jax.lax, "pcast"):
+        aval = jax.typeof(x)
+        current = set(getattr(aval, "vma", ()) or ())
         for axis in axes:
+            if axis in current:
+                continue
             x = jax.lax.pcast(x, axis, to="varying")
         return x
     if hasattr(jax.lax, "pvary"):  # pragma: no cover — older jax

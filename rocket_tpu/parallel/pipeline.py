@@ -16,10 +16,14 @@ body may be rematerialized (``remat=True``) — the standard memory/compute
 trade at pipeline scale.
 
 Bubble fraction is ``(P-1)/(M+P-1)``; pick ``num_microbatches >= P``
-(default ``2*P``) to amortize it. Fill/drain ticks SKIP the stage body via
-``lax.cond`` instead of computing masked garbage (measured -19% forward
-wall-clock on a 4-stage virtual mesh at M=P, where 3/7 of ticks are
-fill/drain).
+(default ``2*P``) to amortize it. Without dropout, fill/drain ticks SKIP
+the stage body via ``lax.cond`` instead of computing masked garbage
+(measured -19% forward wall-clock on a 4-stage virtual mesh at M=P,
+where 3/7 of ticks are fill/drain). With an rng (dropout) the schedule
+falls back to run-and-mask: jax's cond partial-eval cannot join branch
+residuals that differ in varying-axes type (the dropout keys fold in the
+data ``axis_index``), so the cond is not differentiable there — exact
+gradients are worth the fill/drain FLOPs.
 """
 
 from __future__ import annotations
@@ -78,8 +82,9 @@ def pipeline_blocks(
     aux (the GShard fraction x gate product) equals the unpipelined
     full-batch value only at num_microbatches=1 with no data sharding —
     otherwise it is the mean of per-group losses, which is GShard's own
-    grouped formulation. Fill/drain ticks contribute nothing: their
-    compute is skipped outright (lax.cond, no masked garbage FLOPs).
+    grouped formulation. Fill/drain ticks contribute nothing to the
+    result; without an rng their compute is skipped outright (lax.cond),
+    with one (dropout) they run-and-mask (module docstring).
     """
     n_stages = mesh.shape[pipe_axis]
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -177,22 +182,39 @@ def _build(
             incoming, outputs, aux_acc = carry
             # Microbatch this stage works on at tick t. During fill (the
             # stage hasn't received its first microbatch yet) and drain
-            # (all m are through) the stage body is SKIPPED outright via
-            # lax.cond — no FLOPs burned on clipped garbage, where the old
-            # schedule ran the stage and masked the result.
+            # (all m are through) the stage body is skipped via lax.cond
+            # when no rng is present; the dropout path below must
+            # run-and-mask instead (cond isn't differentiable with
+            # axis-varying branch residuals).
             mb = jnp.clip(t - s, 0, m - 1)
             valid = (t - s >= 0) & (t - s < m)
             feed = micro[jnp.clip(t, 0, m - 1)]
             h = jnp.where(s == 0, feed, incoming)
-            y, aux = jax.lax.cond(
-                valid,
-                lambda h: run_stage(h, mb),
-                lambda h: (
+            # Both cond branches must agree in varying-axes type: with
+            # dropout on, run_stage's output is data-varying (the rng
+            # folds in the data axis_index), so the passthrough branch's
+            # operand is declared equally varying up front.
+            h = pvary_compat(h, vary_axes)
+            if rng is None:
+                y, aux = jax.lax.cond(
+                    valid,
+                    lambda h: run_stage(h, mb),
+                    lambda h: (
+                        h,
+                        pvary_compat(jnp.zeros((), jnp.float32), vary_axes),
+                    ),
                     h,
-                    pvary_compat(jnp.zeros((), jnp.float32), vary_axes),
-                ),
-                h,
-            )
+                )
+            else:
+                # With dropout, differentiating lax.cond breaks in jax's
+                # cond partial-eval (branch residuals carry mismatched
+                # varying-axes types). Fall back to run-and-mask: fill/
+                # drain ticks burn stage FLOPs, but gradients are exact
+                # and the loop stays differentiable. h starts from zeros,
+                # so the masked garbage is finite.
+                y, aux = run_stage(h, mb)
+                y = jnp.where(valid, y, h)
+                aux = jnp.where(valid, aux, 0.0)
             aux_acc = aux_acc + aux
             incoming = jax.lax.ppermute(y, pipe_axis, perm)
             out_idx = t - (n_stages - 1)
@@ -205,10 +227,11 @@ def _build(
         incoming = jnp.zeros_like(micro[0])
         aux_acc = jnp.zeros((), jnp.float32)
         # The carries become pipe-varying after one tick (they depend on
-        # the stage index); mark the zero-initialized constants accordingly
-        # so the scan carry types match (jax vma checking).
-        incoming = pvary_compat(incoming, (pipe_axis,))
-        outputs = pvary_compat(outputs, (pipe_axis,))
+        # the stage index) and data-varying when dropout folds the data
+        # axis_index into its keys; mark the zero-initialized constants
+        # accordingly so the scan carry types match (jax vma checking).
+        incoming = pvary_compat(incoming, vary_axes)
+        outputs = pvary_compat(outputs, vary_axes)
         aux_acc = pvary_compat(aux_acc, vary_axes)
         (_, outputs, aux_acc), _ = jax.lax.scan(
             tick, (incoming, outputs, aux_acc), jnp.arange(m + n_stages - 1)
@@ -382,12 +405,15 @@ def _build_1f1b(
             )
             return h
 
+        vary = (pipe_axis,) + ((data_axis,) if data_axis else ())
         zero_h = jnp.zeros_like(micro[0])
         zero_pgrads = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+            lambda p: pvary_compat(jnp.zeros(p.shape, jnp.float32), vary),
+            local_params,
         )
         zero_tgrads = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), tail_params
+            lambda p: pvary_compat(jnp.zeros(p.shape, jnp.float32), vary),
+            tail_params,
         )
 
         def tick(carry, t):
@@ -397,7 +423,12 @@ def _build_1f1b(
             fi = t - s
             f_valid = (fi >= 0) & (fi < m)
             fi_c = jnp.clip(fi, 0, m - 1)
-            h_in = jnp.where(s == 0, micro[fi_c], fwd_in)
+            # Declared fully axis-varying so every lax.cond below has
+            # branch-type agreement (dropout keys fold in the data
+            # axis_index, making stage outputs data-varying).
+            h_in = pvary_compat(
+                jnp.where(s == 0, micro[fi_c], fwd_in), vary
+            )
             slot = fi_c % depth
             buf = buf.at[slot].set(jnp.where(f_valid, h_in, buf[slot]))
             y = jax.lax.cond(
@@ -419,9 +450,12 @@ def _build_1f1b(
             def skip_tail(operand):
                 tp, h, _ = operand
                 return (
-                    jnp.zeros((), jnp.float32),
+                    pvary_compat(jnp.zeros((), jnp.float32), vary),
                     jax.tree.map(
-                        lambda p: jnp.zeros(p.shape, jnp.float32), tp
+                        lambda p: pvary_compat(
+                            jnp.zeros(p.shape, jnp.float32), vary
+                        ),
+                        tp,
                     ),
                     jnp.zeros_like(h),
                 )
@@ -469,18 +503,17 @@ def _build_1f1b(
             bwd_in = jax.lax.ppermute(dh_prev, pipe_axis, down)
             return (fwd_in, bwd_in, buf, pgrads, tgrads, loss_acc, dx_buf), None
 
-        vary = (pipe_axis,) + ((data_axis,) if data_axis else ())
         carry0 = (
-            pvary_compat(zero_h, (pipe_axis,)),                       # fwd_in
-            pvary_compat(jnp.zeros_like(zero_h), (pipe_axis,)),       # bwd_in
+            pvary_compat(zero_h, vary),                               # fwd_in
+            pvary_compat(jnp.zeros_like(zero_h), vary),               # bwd_in
             pvary_compat(
-                jnp.zeros((depth, *zero_h.shape), zero_h.dtype), (pipe_axis,)
+                jnp.zeros((depth, *zero_h.shape), zero_h.dtype), vary
             ),                                                        # buf
-            jax.tree.map(lambda z: pvary_compat(z, (pipe_axis,)), zero_pgrads),
-            jax.tree.map(lambda z: pvary_compat(z, vary), zero_tgrads),
+            zero_pgrads,                                              # pvary'd
+            zero_tgrads,                                              # pvary'd
             pvary_compat(jnp.zeros((), jnp.float32), vary),           # loss
             pvary_compat(
-                jnp.zeros((m, *zero_h.shape), zero_h.dtype), (pipe_axis,)
+                jnp.zeros((m, *zero_h.shape), zero_h.dtype), vary
             ),                                                        # dx
         )
         ticks = jnp.arange(m + 2 * n_stages - 2)

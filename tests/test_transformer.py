@@ -638,21 +638,26 @@ def test_generate_eos_freezes_finished_sequences():
 
 
 @pytest.mark.slow
-def test_1f1b_matches_gpipe_loss_and_grads(tmp_path):
+@pytest.mark.parametrize("dropout", [0.0, 0.2])
+def test_1f1b_matches_gpipe_loss_and_grads(tmp_path, dropout):
     """pipeline_schedule='1f1b' (fused fwd+bwd, O(P) activations) must
     produce the same loss and param grads as the autodiff'd GPipe path on
-    the same params/batch (virtual ('data','pipe') mesh)."""
+    the same params/batch (virtual ('data','pipe') mesh). WITH dropout the
+    schedules must still agree exactly: both derive masks from
+    fold_in(rng, microbatch, data-shard, layer), and the 1F1B backward
+    replays the same keys when it recomputes the stage forward."""
     import dataclasses
 
     base = TransformerConfig(
         vocab_size=64, max_seq_len=32, dim=32, num_layers=4, num_heads=4,
-        dropout=0.0, scan_layers=True, pipeline_axis="pipe",
+        dropout=dropout, scan_layers=True, pipeline_axis="pipe",
         pipeline_microbatches=4,
     )
     tokens = jnp.asarray(
         np.random.default_rng(3).integers(0, 64, (8, 32)), jnp.int32
     )
     objective = next_token_loss()
+    rng = jax.random.key(7) if dropout else None
 
     def loss_and_grads(schedule):
         runtime = Runtime(mesh_shape={"data": 2, "pipe": 4}, seed=0,
@@ -666,7 +671,7 @@ def test_1f1b_matches_gpipe_loss_and_grads(tmp_path):
             assert vag is not None
             (loss, _), grads = jax.jit(vag)(
                 variables["params"], variables["state"], {"tokens": tokens},
-                None,
+                rng,
             )
             return loss, grads
 
@@ -674,7 +679,8 @@ def test_1f1b_matches_gpipe_loss_and_grads(tmp_path):
 
         def f(p):
             out, _ = model.apply(
-                {"params": p, "state": {}}, {"tokens": tokens}, mode="train"
+                {"params": p, "state": {}}, {"tokens": tokens},
+                mode="train", rng=rng,
             )
             return objective(out)
 
