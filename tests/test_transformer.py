@@ -638,8 +638,10 @@ def test_generate_eos_freezes_finished_sequences():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("dropout", [0.0, 0.2])
-def test_1f1b_matches_gpipe_loss_and_grads(tmp_path, dropout):
+@pytest.mark.parametrize("dropout,family", [
+    (0.0, "gpt2"), (0.2, "gpt2"), (0.0, "llama"),
+])
+def test_1f1b_matches_gpipe_loss_and_grads(tmp_path, dropout, family):
     """pipeline_schedule='1f1b' (fused fwd+bwd, O(P) activations) must
     produce the same loss and param grads as the autodiff'd GPipe path on
     the same params/batch (virtual ('data','pipe') mesh). WITH dropout the
@@ -648,10 +650,17 @@ def test_1f1b_matches_gpipe_loss_and_grads(tmp_path, dropout):
     replays the same keys when it recomputes the stage forward."""
     import dataclasses
 
+    extra = (
+        # Llama-family knobs: RoPE + RMSNorm + SwiGLU + GQA + UNTIED head
+        # — exercises the 1F1B tail's separate-head branch.
+        dict(num_kv_heads=2, pos_embedding="rope", norm="rmsnorm",
+             mlp="swiglu", tied_embeddings=False)
+        if family == "llama" else {}
+    )
     base = TransformerConfig(
         vocab_size=64, max_seq_len=32, dim=32, num_layers=4, num_heads=4,
         dropout=dropout, scan_layers=True, pipeline_axis="pipe",
-        pipeline_microbatches=4,
+        pipeline_microbatches=4, **extra,
     )
     tokens = jnp.asarray(
         np.random.default_rng(3).integers(0, 64, (8, 32)), jnp.int32
