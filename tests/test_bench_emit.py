@@ -1,0 +1,132 @@
+"""The bench stdout line must fit the driver's 2,000-byte tail capture.
+
+BENCH_r04.json came back ``parsed: null`` because the monolithic line
+(headline + full per-config ``extra``) outgrew the capture window. The
+round-5 contract: ``bench.format_line`` emits a compact self-contained
+headline ≤ ``bench.MAX_LINE_BYTES`` (1,500 < 2,000 with headroom) no
+matter how many configs exist or fail, and ``bench.write_detail`` carries
+the full record to BENCH_DETAIL.json. These tests feed worst-case inputs
+through the real emission path so adding a config can never silently
+re-break the artifact.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def _full_result(name, rounds=8):
+    """A maximal per-config result: every field populated, long history."""
+    return {
+        "metric": bench.METRIC_NAMES.get(
+            name, f"{name}_tok_per_sec_per_chip"
+        ),
+        "value": 1234567.8,
+        "unit": "tok/sec/chip",
+        "vs_baseline": 12.345,
+        "mfu": 0.5678,
+        "best_value": 1345678.9,
+        "best_mfu": 0.6123,
+        "history": {f"r{i:02d}": 1234567.8 + i for i in range(1, rounds)}
+        | {"now": 1234567.8},
+    }
+
+
+def _worst_case_results(n_extra=20):
+    """Every real config fully populated, plus n_extra future configs —
+    far beyond any plausible growth of BENCHES."""
+    results = {name: _full_result(name) for name in bench.BENCHES}
+    for i in range(n_extra):
+        results[f"future_config_with_a_long_name_{i:02d}"] = _full_result(
+            f"future_config_with_a_long_name_{i:02d}"
+        )
+    return results
+
+
+def test_line_fits_capture_worst_case():
+    line = bench.format_line(_worst_case_results())
+    assert len(line) <= bench.MAX_LINE_BYTES
+    parsed = json.loads(line)
+    # The headline must survive every degradation step.
+    assert parsed["metric"] == bench.METRIC_NAMES["gpt2"]
+    assert parsed["value"] == 1234567.8
+    assert parsed["mfu"] == 0.5678
+    assert parsed["detail"] == "BENCH_DETAIL.json"
+
+
+def test_line_fits_when_everything_errors():
+    """str(exc) from an XLA failure routinely runs kilobytes — the line
+    must fit even when every config carries an unbounded error string."""
+    results = {
+        name: {"metric": bench.METRIC_NAMES[name],
+               "error": "XlaRuntimeError: " + "x" * 8000}
+        for name in bench.BENCHES
+    }
+    line = bench.format_line(results)
+    assert len(line) <= bench.MAX_LINE_BYTES
+    parsed = json.loads(line)
+    assert parsed["error"].startswith("XlaRuntimeError")
+
+
+def test_normal_sweep_keeps_summary_and_history():
+    """At today's config count nothing should be degraded away: the line
+    carries the headline history AND one value per other config."""
+    results = {name: _full_result(name) for name in bench.BENCHES}
+    line = bench.format_line(results)
+    assert len(line) <= bench.MAX_LINE_BYTES
+    parsed = json.loads(line)
+    assert "history" in parsed
+    others = parsed["others"]
+    for name in bench.BENCHES:
+        if name == "gpt2":
+            continue
+        assert others[name] == 1234567.8
+    assert others["resnet50_mfu"] == 0.568
+
+
+def test_write_detail_round_trips(tmp_path):
+    results = {name: _full_result(name) for name in bench.BENCHES}
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail(results, path=str(path))
+    detail = json.loads(path.read_text())
+    assert detail["headline_metric"] == bench.METRIC_NAMES["gpt2"]
+    assert set(detail["configs"]) == set(bench.BENCHES)
+    # Full fidelity: the detail file keeps what the line drops.
+    assert detail["configs"]["llama"]["history"]["r01"] == 1234568.8
+
+
+def test_write_detail_merges_partial_runs(tmp_path):
+    """A --config X debugging run must not clobber the full-sweep record
+    the stdout 'detail' pointer references."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    full = {name: _full_result(name) for name in bench.BENCHES}
+    bench.write_detail(full, path=str(path))
+    partial = {"gpt2": dict(_full_result("gpt2"), value=999.9)}
+    bench.write_detail(partial, path=str(path))
+    detail = json.loads(path.read_text())
+    assert set(detail["configs"]) == set(bench.BENCHES)
+    assert detail["configs"]["gpt2"]["value"] == 999.9
+    assert detail["configs"]["llama"]["value"] == 1234567.8
+
+
+def test_write_detail_survives_corrupt_prior(tmp_path):
+    path = tmp_path / "BENCH_DETAIL.json"
+    for corrupt in ("{not json", "[1,2]", '"a string"', ""):
+        path.write_text(corrupt)
+        bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+        assert "mlp" in json.loads(path.read_text())["configs"]
+
+
+def test_write_detail_partial_run_keeps_gpt2_headline(tmp_path):
+    """The merged record's headline must stay gpt2 after a debug run of
+    a different config."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    full = {name: _full_result(name) for name in bench.BENCHES}
+    bench.write_detail(full, path=str(path))
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    detail = json.loads(path.read_text())
+    assert detail["headline_metric"] == bench.METRIC_NAMES["gpt2"]
