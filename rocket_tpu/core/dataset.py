@@ -125,7 +125,11 @@ class Dataset(Capsule):
             prepared = self._make_loader(runtime)
             runtime.dataloaders.add(self._raw_dataset, prepared, self._registry_key)
         # Holder count: a shared loader is closed only by its LAST capsule.
-        runtime.dataloaders.retain(self._raw_dataset, self._registry_key)
+        # Guarded so a repeated setup without an intervening destroy (e.g. a
+        # tree re-dispatched SETUP) can't inflate the count and keep the
+        # worker pool alive past the last destroy (round-4 advisor).
+        if self._dataloader is None:
+            runtime.dataloaders.retain(self._raw_dataset, self._registry_key)
         self._dataloader = prepared
         self._device_resident = isinstance(prepared, DeviceCachedLoader)
         if self._device_placement is None:

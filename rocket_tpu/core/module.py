@@ -73,11 +73,12 @@ def _split_batch(batch):
 
 
 def _strip_marker(batch):
-    """Drop the device-gather marker's all-None residue from a merged
+    """Drop the device-gather/slice marker's all-None residue from a merged
     output batch (the step materialized the real rows; downstream capsules
     must see only data keys)."""
     if isinstance(batch, dict):
         batch.pop("_device_gather", None)
+        batch.pop("_device_slice", None)
     return batch
 
 
@@ -244,10 +245,19 @@ class Module(Dispatcher):
                 variables = jax.block_until_ready(
                     jax.jit(self._model.init)(key)
                 )
-            except Exception as exc:  # noqa: BLE001 — semantics over speed
-                self.log_info(
-                    "compiled init failed (%s: %s) — falling back to eager "
-                    "init", type(exc).__name__, exc,
+            except (TypeError, jax.errors.UnexpectedTracerError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerBoolConversionError) as exc:
+                # Only TRACE-time failures mean "this init isn't jittable —
+                # run it eagerly". Execution failures (OOM, numerics) would
+                # fail eagerly too: falling back would run the broken init
+                # twice and bury the first, more precise error (round-4
+                # advisor) — let those propagate.
+                self.log_warning(
+                    f"compiled init failed ({type(exc).__name__}: {exc}) — "
+                    "falling back to eager init"
                 )
                 variables = self._model.init(key)
             state = {
@@ -584,6 +594,10 @@ class Module(Dispatcher):
                 metrics["grad_norm"] = (
                     optax.global_norm(grads) if accum == 1 else accum_grad_norm
                 )
+            if isinstance(out, dict) and "moe_frac_dropped" in out:
+                # MoE capacity-overflow fraction: a scalar worth tracking
+                # even when the (large) output batch isn't returned.
+                metrics["moe_frac_dropped"] = out["moe_frac_dropped"]
             if return_out:
                 metrics["outputs"] = out
             return new_state, metrics

@@ -105,6 +105,16 @@ def main(argv=None) -> int:
             # WITH a coordinator bind/connect signature in the output. A
             # deterministic user failure (import error, assertion) must not
             # be executed again — it would repeat its side effects.
+            if rc != 0 and fast_failure and not coord_error:
+                # Make a missed signature diagnosable: if this WAS a port
+                # race whose message text the regex doesn't know, the
+                # operator sees why no retry happened (round-4 advisor).
+                sys.stderr.write(
+                    "launch: fast failure without a coordinator-error "
+                    "signature in worker output — not retrying (pass "
+                    "--coordinator-port to pin, or report the failure "
+                    "text if this was a port race)\n"
+                )
             break
         if attempt < _MAX_PORT_RETRIES:
             sys.stderr.write(

@@ -122,8 +122,16 @@ def decode_attention(
     key/value rows (already rotated if RoPE); ``k_cache``/``v_cache``
     (B, Hkv, T_max, D) with valid rows ``[0, pos)``; ``pos`` a traced
     int32 scalar. Returns ``(out (B, Hq, D), k_cache', v_cache')`` with
-    row ``pos`` written — the caches are updated in place (aliased
-    buffers), matching ``dynamic_update_slice`` semantics.
+    row ``pos`` written.
+
+    .. warning:: ``k_cache``/``v_cache`` are DONATED (aliased via
+       ``input_output_aliases``): the caller's buffers are invalidated by
+       the call and must not be read afterwards — use the returned caches.
+       Under ``jit`` tracing (how ``apply_cached``/``generate`` consume
+       this) the dataflow handles that automatically; an eager TPU caller
+       that keeps the pre-call arrays gets undefined contents. This is
+       stricter than ``dynamic_update_slice``, which leaves its operand
+       intact at the cost of a full cache copy per decoded token.
     """
     b, hq, d = q.shape
     h_kv, t = k_cache.shape[1], k_cache.shape[2]

@@ -155,3 +155,22 @@ def test_shared_loader_closed_only_by_last_holder(runtime):
     d2.destroy()
     assert closed  # last holder tears it down
     assert runtime.dataloaders.lookup(raw, d2._registry_key) is None
+
+
+def test_repeated_setup_does_not_leak_holder_count(runtime):
+    """SETUP dispatched twice without an intervening destroy must not
+    inflate the shared loader's holder count: ONE destroy still closes it
+    (round-4 advisor finding)."""
+    raw = make_samples(8)
+    d = Dataset(raw, batch_size=4, device_cache=False, statefull=False,
+                runtime=runtime)
+    d.setup()
+    d.setup()  # e.g. a tree re-dispatching SETUP
+    loader = d._dataloader
+    closed = []
+    orig_close = loader.close
+    loader.close = lambda: (closed.append(1), orig_close())
+
+    d.destroy()
+    assert closed  # a leaked retain would keep the worker pool alive
+    assert runtime.dataloaders.lookup(raw, d._registry_key) is None

@@ -12,6 +12,7 @@ import pytest
 import rocket_tpu as rt
 from rocket_tpu import optim
 from rocket_tpu.models.mlp import MLP
+from rocket_tpu.nn.module import Model
 from rocket_tpu.utils.metrics import Accuracy
 
 
@@ -612,3 +613,49 @@ def test_kitchen_sink_train_save_resume(tmp_path):
     module3_ref.append(module3)
     launcher3.launch()
     assert final["step"] == 4, final  # trained past the restored step
+
+
+class _UntraceableInitModel(Model):
+    """init() concretizes the traced key -> trace-time failure under jit."""
+
+    def init(self, key):
+        import jax
+
+        # np.asarray on a tracer raises TracerArrayConversionError under
+        # jit; eagerly it works fine.
+        seed = int(np.asarray(jax.random.key_data(key)).sum()) % (2**31)
+        w = np.random.default_rng(seed).normal(size=(8, 4)).astype(np.float32)
+        return {"params": {"w": w}}
+
+    def apply(self, variables, batch, *, mode="train", rng=None):
+        out = dict(batch)
+        out["logits"] = batch["image"] @ variables["params"]["w"]
+        return out, {}
+
+
+class _BrokenInitModel(_UntraceableInitModel):
+    """init() raises a genuine user error — must propagate, not fall back
+    to a second eager execution (round-4 advisor)."""
+
+    def init(self, key):
+        raise ValueError("broken init: deliberate")
+
+
+def test_untraceable_init_falls_back_to_eager(runtime8, caplog):
+    import logging
+
+    model = _UntraceableInitModel()
+    module = rt.Module(model, runtime=runtime8)
+    with caplog.at_level(logging.WARNING):
+        module.setup()
+    assert module.state["params"]["w"].shape == (8, 4)
+    # The fallback is loud: a warning names the trace failure.
+    assert any("falling back to eager init" in r.message for r in caplog.records)
+    module.destroy()
+
+
+def test_broken_init_propagates_once(runtime8):
+    model = _BrokenInitModel()
+    module = rt.Module(model, runtime=runtime8)
+    with pytest.raises(ValueError, match="broken init"):
+        module.setup()
