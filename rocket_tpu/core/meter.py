@@ -86,7 +86,9 @@ class Meter(Dispatcher):
         if attrs is None or attrs.batch is None:
             return
         batch = attrs.batch
-        if isinstance(batch, dict) and "_device_gather" in batch:
+        if isinstance(batch, dict) and (
+            "_device_gather" in batch or "_device_slice" in batch
+        ):
             # A fused-gather marker reached the Meter un-materialized (no
             # Module replaced the batch — e.g. a train-mode Meter over raw
             # labels): gather the real rows eagerly so key access works.

@@ -285,3 +285,45 @@ def test_device_cache_dtype_and_store_keying():
     assert cache_bf16["y"].dtype == jnp.int32  # ints untouched
     assert cache_f32["x"].dtype == jnp.float32
     assert len(runtime.device_cache_store) == 2  # separate entries
+
+
+def test_slice_marker_on_unshuffled_contiguous_batches():
+    """Unshuffled device-cached batches are contiguous cache runs, so the
+    fused marker degrades to "_device_slice" (dynamic_slice instead of a
+    general gather — round-4 verdict ask #2). Shuffled or wrap-padded
+    epochs must keep the gather marker; both materialize identical rows."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.data.device_cache import (
+        DeviceCachedLoader, materialize_marker,
+    )
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(seed=0)
+    data = {
+        "x": np.arange(24, dtype=np.float32).reshape(12, 2),
+        "y": np.arange(12, dtype=np.int32),
+    }
+
+    seq = DeviceCachedLoader(data, batch_size=4, runtime=runtime)
+    batches = list(seq)
+    assert all("_device_slice" in b.data for b in batches)
+    rows = [np.asarray(materialize_marker(b.data)["y"]) for b in batches]
+    np.testing.assert_array_equal(np.concatenate(rows), data["y"])
+
+    # Row shuffle -> gather marker (rows within a batch non-contiguous).
+    shuf = DeviceCachedLoader(data, batch_size=4, runtime=runtime,
+                              shuffle=True)
+    assert all("_device_gather" in b.data for b in shuf)
+
+    # Wrap-padded last batch (12 % 5 != 0, drop_last=False) -> gather.
+    wrap = DeviceCachedLoader(data, batch_size=5, runtime=runtime)
+    assert all("_device_gather" in b.data for b in wrap)
+
+    # drop_last trims the remainder, so contiguity holds -> slice.
+    trim = DeviceCachedLoader(data, batch_size=5, runtime=runtime,
+                              drop_last=True)
+    tb = list(trim)
+    assert all("_device_slice" in b.data for b in tb)
+    rows = [np.asarray(materialize_marker(b.data)["y"]) for b in tb]
+    np.testing.assert_array_equal(np.concatenate(rows), data["y"][:10])
