@@ -559,16 +559,16 @@ class TransformerLM(Model):
         block_apply = self._pipe_block_apply.get(mode)
         if block_apply is None:
             block = self.blocks[0]
-            has_data = "data" in self._pipe_mesh.shape
 
             def block_apply(params_i, idx, mb, h, r):
                 if r is not None:
-                    # Distinct dropout masks per microbatch AND per data
-                    # shard — one shared key would correlate every
-                    # microbatch's mask.
+                    # Distinct dropout masks per microbatch — one shared
+                    # key would correlate every microbatch's mask. The
+                    # per-data-shard fold happens in the pipeline itself
+                    # (BEFORE any lax.cond — the differentiable fill/drain
+                    # skip needs the key data-varying at cond entry, see
+                    # parallel/pipeline.py module docstring).
                     r = jax.random.fold_in(r, mb)
-                    if has_data:
-                        r = jax.random.fold_in(r, jax.lax.axis_index("data"))
                 y, bstate = block.apply(
                     {"params": params_i, "state": {}}, h,
                     mode=mode, rng=r, layer_idx=idx,
