@@ -31,6 +31,29 @@ from rocket_tpu.nn.module import Layer
 __all__ = ["MoE"]
 
 
+def _grouped_matmul(lhs, rhs, group_sizes):
+    """``lhs`` rows grouped by ``group_sizes`` times per-group ``rhs[g]``.
+
+    TPU: the pallas megablox ``gmm`` kernel — with 512-wide tiles it runs
+    within ~5% of a dense batched einsum PER ROW (measured at bench-MoE
+    shapes; the default 128 tiling is ~2x slower, and
+    ``jax.lax.ragged_dot``'s XLA lowering ~1.4x slower — probe record in
+    docs/performance.md). Elsewhere (CPU tests) ``ragged_dot`` — identical
+    semantics, no Mosaic.
+    """
+    m, k = lhs.shape
+    _, _, n = rhs.shape
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and k % 128 == 0 and n % 128 == 0 and m % 8 == 0:
+        from jax.experimental.pallas.ops.tpu.megablox.ops import gmm
+
+        tiling = (min(512, m), min(512, k), min(512, n))
+        return gmm(lhs, rhs, group_sizes, lhs.dtype, tiling)
+    return jax.lax.ragged_dot(
+        lhs, rhs, group_sizes, preferred_element_type=lhs.dtype
+    )
+
+
 class MoE(Layer):
     """Top-k routed expert FFN (drop-in for the dense MLP in a block).
 
@@ -50,7 +73,7 @@ class MoE(Layer):
             raise ValueError(
                 f"MoE: top_k {top_k} must be in [1, num_experts={num_experts}]"
             )
-        if dispatch not in ("einsum", "scatter"):
+        if dispatch not in ("einsum", "scatter", "dropless"):
             raise ValueError(f"MoE: unknown dispatch mode {dispatch!r}")
         self.dim = dim
         self.hidden = hidden
@@ -66,6 +89,11 @@ class MoE(Layer):
         #: experts are NOT sharded over a mesh axis (XLA's scatter does not
         #: lower to all-to-alls as cleanly). Both modes compute identical
         #: outputs (tested).
+        #: "dropless": sort-based dispatch + ``jax.lax.ragged_dot`` grouped
+        #: matmuls — does ONLY the routed work (no capacity padding, no
+        #: E×C one-hots, no token drops; round-4 verdict ask #3). Single-
+        #: device experts only: ragged_dot has no all-to-all lowering under
+        #: expert sharding, so keep "einsum" for an 'expert' mesh axis.
         self.dispatch = dispatch
         self.router = Dense(dim, num_experts, use_bias=False)
 
@@ -97,6 +125,16 @@ class MoE(Layer):
         top_gates = top_gates / jnp.maximum(
             jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9
         )
+
+        if self.dispatch == "dropless":
+            y = self._apply_dropless(p, x, top_gates, top_idx)
+            aux, _ = self._aux_loss(gates, top_idx, e)
+            # No capacity, no drops — every routed (token, choice) pair is
+            # computed. frac_dropped is identically 0 by construction.
+            return y, {
+                "aux_loss": aux,
+                "frac_dropped": jnp.zeros((), jnp.float32),
+            }
 
         # GShard-style GROUPED routing: each batch row is a routing group
         # with its own capacity, so the dispatch one-hots are
@@ -162,11 +200,7 @@ class MoE(Layer):
         else:
             y = jnp.einsum("btec,ebcd->btd", combine, out)
 
-        # -- load-balancing aux loss (GShard eq. 4) -----------------------
-        primary = jax.nn.one_hot(top_idx[..., 0], e, dtype=jnp.float32)
-        fraction_routed = jnp.mean(primary, axis=(0, 1))  # tokens per expert
-        mean_gate = jnp.mean(gates, axis=(0, 1))
-        aux = e * jnp.sum(fraction_routed * mean_gate)
+        aux, _ = self._aux_loss(gates, top_idx, e)
 
         # Capacity utilization: the fraction of routed (token, choice)
         # pairs that found an expert slot. 1 - frac_kept is the dropped
@@ -176,6 +210,61 @@ class MoE(Layer):
         frac_dropped = 1.0 - jnp.mean(keep.astype(jnp.float32))
 
         return y, {"aux_loss": aux, "frac_dropped": frac_dropped}
+
+    @staticmethod
+    def _aux_loss(gates, top_idx, e):
+        """GShard eq. 4 load-balancing loss (dispatch-mode independent)."""
+        primary = jax.nn.one_hot(top_idx[..., 0], e, dtype=jnp.float32)
+        fraction_routed = jnp.mean(primary, axis=(0, 1))  # tokens per expert
+        mean_gate = jnp.mean(gates, axis=(0, 1))
+        return e * jnp.sum(fraction_routed * mean_gate), fraction_routed
+
+    def _apply_dropless(self, p, x, top_gates, top_idx):
+        """Sort-based dropless dispatch: grouped matmuls over exactly the
+        routed (token, choice) pairs via ``jax.lax.ragged_dot``.
+
+        The einsum/scatter modes execute ``capacity_factor``x the routed
+        FLOPs (expert matmuls run on C padded slots) plus O(B*T*E*C)
+        dispatch/combine contractions — measured ~20 ms/step of genuinely
+        wasted work at the bench MoE config (docs/performance.md). Here:
+
+        * flatten to N = B*T tokens, NK = N*k (token, choice) pairs;
+        * stable-argsort pairs by expert id — per-expert rows contiguous;
+        * gather the pair rows of x (NK, D), run both expert matmuls as
+          ragged group-matmuls (group sizes = per-expert pair counts);
+        * scatter-add gate-weighted outputs back per token.
+
+        No capacity concept: counts are data-dependent VALUES but every
+        shape is static (NK rows total), so it jits cleanly. Routing-
+        identical to the other modes with unlimited capacity; with finite
+        capacity those modes additionally DROP overflow pairs.
+        """
+        b, t, d = x.shape
+        e, k = self.num_experts, self.top_k
+        n = b * t
+        x_flat = x.reshape(n, d)
+
+        pair_expert = top_idx.reshape(n * k)          # token-major pairs
+        pair_token = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+        order = jnp.argsort(pair_expert, stable=True)
+        sorted_expert = pair_expert[order]
+        sorted_token = pair_token[order]
+        counts = jnp.bincount(pair_expert, length=e).astype(jnp.int32)
+
+        ex = p["experts"]
+        xs = x_flat[sorted_token]                     # (NK, D)
+        h = _grouped_matmul(xs, ex["w_in"].astype(x.dtype), counts)  # (NK, H)
+        h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[sorted_expert])
+        out = _grouped_matmul(h, ex["w_out"].astype(x.dtype), counts)
+        out = out + ex["b_out"].astype(x.dtype)[sorted_expert]       # (NK, D)
+
+        gate_sorted = top_gates.reshape(n * k)[order].astype(x.dtype)
+        y = (
+            jnp.zeros((n, d), x.dtype)
+            .at[sorted_token]
+            .add(out * gate_sorted[:, None])
+        )
+        return y.reshape(b, t, d)
 
     def __repr__(self):
         return (
