@@ -299,8 +299,26 @@ def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):
         compute_dtype=jnp.bfloat16,
     )
     timer = Timer(module, warmup, steps)
+    moe_dropped = {}
+
+    class MoESpy(rt.Capsule):
+        """Keeps a handle on the last step's capacity-overflow fraction (a
+        device scalar from step_metrics; fetched ONCE after the run —
+        never mid-loop)."""
+
+        def __init__(self):
+            super().__init__(priority=40)  # after the Timer
+
+        def launch(self, attrs=None):
+            if attrs is not None and attrs.step_metrics is not None:
+                v = attrs.step_metrics.moe_frac_dropped
+                if v is not None:
+                    moe_dropped["value"] = v
+
+    extra_capsules = [MoESpy()] if config.num_experts > 0 else []
     _train(
-        [rt.Dataset(data, batch_size=batch, drop_last=True), module],
+        [rt.Dataset(data, batch_size=batch, drop_last=True), module]
+        + extra_capsules,
         runtime, timer,
     )
     best_tok_per_chip = batch * seq / timer.best_step_time() / n_dev
@@ -325,6 +343,10 @@ def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):
         # comparable); "best_mfu" tracks the fastest window.
         out["mfu"] = round(tok_per_chip * flops_per_tok / peak, 4)
         out["best_mfu"] = round(best_tok_per_chip * flops_per_tok / peak, 4)
+    if "value" in moe_dropped:
+        # Capacity waste tracked round-over-round (round-4 verdict ask #3);
+        # identically 0 under the dropless dispatch.
+        out["frac_dropped"] = round(float(np.asarray(moe_dropped["value"])), 4)
     return out
 
 
@@ -589,7 +611,14 @@ def write_detail(results, path=DETAIL_PATH):
                    if isinstance(v, dict)}
     except Exception:  # noqa: BLE001 — any malformed prior starts fresh
         pass
-    configs.update(results)
+    for name, r in results.items():
+        if "error" in r and "error" not in configs.get(name, {"error": 1}):
+            # An errored re-run (debugging OOM, transient XLA failure) must
+            # not destroy a committed good record — annotate it instead.
+            configs[name] = dict(configs[name],
+                                 last_error=str(r["error"])[:200])
+        else:
+            configs[name] = r
     detail = {
         # Headline from the MERGED set: a --config mlp debug run must not
         # repoint the full-sweep record's headline away from gpt2.
