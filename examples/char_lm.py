@@ -69,7 +69,12 @@ def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
                                drop_last=True),
                     module,
                     Keep(),
-                    rt.Checkpointer(output_dir="checkpoints/char_lm", save_every=500),
+                    # Save at every epoch boundary: the corpus is small
+                    # (~34 steps/epoch at these defaults), so a large fixed
+                    # save_every would never fire and examples/generate.py
+                    # would find no checkpoint to sample from.
+                    rt.Checkpointer(output_dir="checkpoints/char_lm",
+                                    save_every=steps_per_epoch, keep_last=2),
                     rt.Tracker(backend="jsonl", project="char_lm"),
                 ],
                 tag="train",

@@ -1,0 +1,106 @@
+"""Sample text from a trained char-LM checkpoint — the decode path as a
+user-facing artifact (round-4 verdict ask #8).
+
+Loads params from a ``char_lm.py`` checkpoint (or trains a short run
+first when none exists), then generates with :func:`generate`: one
+compiled prefill + incremental decode through per-layer KV caches; when
+the cache shape qualifies, single-token attention runs the fused pallas
+decode kernel (``ops/decode_attention.py``) automatically.
+
+    python examples/char_lm.py                 # train + checkpoint
+    python examples/generate.py --prompt "KING: " --tokens 200
+    python examples/generate.py --greedy       # argmax decode
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+from rocket_tpu.core.checkpoint import Checkpointer
+from rocket_tpu.data.text import CharTokenizer, tiny_shakespeare
+from rocket_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    generate,
+)
+from rocket_tpu.runtime import checkpoint_io
+
+SEQ_LEN = 256  # must match char_lm.py's training config
+
+
+def load_params(model, ckpt_dir: str):
+    """Newest complete checkpoint's params, restored onto the device via
+    the resharding reader (the checkpoint may have been written by any
+    process count / sharding)."""
+    latest = Checkpointer(
+        output_dir=ckpt_dir, resume_from="latest"
+    )._resolve_resume_path("latest")
+    if latest is None:
+        return None
+    template = {"params": jax.jit(model.init)(jax.random.key(0))["params"]}
+    restored = checkpoint_io.load_pytree(
+        os.path.join(latest, "model_0"), template
+    )
+    print(f"loaded params from {latest}")
+    return restored["params"]
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ckpt", default="checkpoints/char_lm",
+                        help="checkpoint dir written by char_lm.py")
+    parser.add_argument("--prompt", default="the ")
+    parser.add_argument("--tokens", type=int, default=128,
+                        help="tokens to generate")
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--top-k", type=int, default=20)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--greedy", action="store_true",
+                        help="argmax decode (ignores temperature/top-k/p)")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    # The tokenizer is a pure function of the corpus — rebuild it rather
+    # than persisting vocab files.
+    tok = CharTokenizer(tiny_shakespeare())
+    config = TransformerConfig.char_lm(
+        vocab_size=tok.vocab_size, max_seq_len=SEQ_LEN
+    )
+    model = TransformerLM(config)
+
+    params = load_params(model, args.ckpt)
+    if params is None:
+        print(f"no checkpoint under {args.ckpt!r} — training one first "
+              "(examples/char_lm.py, 1 epoch)...")
+        import examples.char_lm as char_lm
+
+        char_lm.main(num_epochs=1)
+        params = load_params(model, args.ckpt)
+        if params is None:
+            raise SystemExit(
+                "char_lm.py finished but left no complete checkpoint under "
+                f"{args.ckpt!r}"
+            )
+
+    prompt = tok.encode(args.prompt)[None, :]
+    max_new = min(args.tokens, config.max_seq_len - prompt.shape[1])
+    if max_new < args.tokens:
+        print(f"clamping to {max_new} tokens (max_seq_len={config.max_seq_len})")
+    out = generate(
+        model, {"params": params, "state": {}}, prompt, max_new,
+        key=jax.random.key(args.seed),
+        temperature=0.0 if args.greedy else args.temperature,
+        top_k=None if args.greedy else args.top_k,
+        top_p=None if args.greedy else args.top_p,
+    )
+    print("-" * 60)
+    print(tok.decode(np.asarray(out[0])))
+
+
+if __name__ == "__main__":
+    main()
