@@ -197,3 +197,193 @@ def test_two_process_distributed_train_and_checkpoint(tmp_path):
         outs.append(out)
         assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"RANK{rank} OK" in out, out
+
+
+# ---------------------------------------------------------------------------
+# Elastic restore across process counts (round-4 verdict ask #5): save under
+# 2 real jax.distributed processes, restore under 1 and under 4 (the
+# resharding reader rebuilds each leaf from whatever chunk files exist),
+# verify bitwise state equality against a rank-0 reference dump, and train on.
+# ---------------------------------------------------------------------------
+
+_ELASTIC_COMMON = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.runtime.context import Runtime
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+def make_data():
+    rng = np.random.default_rng(0)
+    return [
+        {"image": rng.normal(size=8).astype(np.float32),
+         "label": np.int32(i % 4)}
+        for i in range(128)
+    ]
+
+def build_tree(runtime, ckpt_dir, resume_from=None):
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    # save_every=2 -> a MID-epoch checkpoint at step 2 (of the 4-batch
+    # epoch): restoring it leaves batches to train, so the continuation
+    # leg actually advances.
+    tree = rt.Launcher(
+        [rt.Looper(
+            [rt.Dataset(make_data(), batch_size=32, device_cache=False),
+             module,
+             rt.Checkpointer(output_dir=ckpt_dir, save_every=2,
+                             resume_from=resume_from)],
+            tag="train", progress=False)],
+        num_epochs=1, runtime=runtime,
+    )
+    return tree, module
+
+def flat_state(module):
+    # Full host values keyed like the checkpoint index: every leaf is
+    # replicated over the data mesh, so addressable shard 0 IS the global
+    # array on any process count.
+    from rocket_tpu.utils.pytree import key_path_str
+    out = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(
+            {"params": module.state["params"],
+             "opt_state": module.state["opt_state"],
+             "step": module.state["step"]})[0]:
+        out[key_path_str(kp)] = np.asarray(leaf.addressable_data(0))
+    return out
+
+def run_one_epoch(tree, attrs):
+    # The Launcher.launch epoch body, without its closing destroy (state
+    # must stay inspectable after the run).
+    from rocket_tpu.core.capsule import Events
+    attrs.launcher = rt.Attributes(epoch_idx=0, num_epochs=1)
+    for capsule in tree.capsules:
+        capsule.dispatch(Events.SET, attrs)
+        capsule.dispatch(Events.LAUNCH, attrs)
+        capsule.dispatch(Events.RESET, attrs)
+"""
+
+_ELASTIC_SAVER = _ELASTIC_COMMON + r"""
+runtime = Runtime(mesh_shape={"data": 4}, seed=0, project_dir=os.environ["OUT"])
+assert jax.process_count() == 2
+ckpt_dir = os.path.join(os.environ["OUT"], "ckpts")
+tree, module = build_tree(runtime, ckpt_dir)
+tree.launch()
+assert os.path.isdir(os.path.join(ckpt_dir, "2")), os.listdir(ckpt_dir)
+print(f"RANK{runtime.process_index} SAVED", flush=True)
+"""
+
+_ELASTIC_RESTORER = _ELASTIC_COMMON + r"""
+runtime = Runtime(mesh_shape={"data": 4}, seed=0, project_dir=os.environ["OUT"])
+nproc = jax.process_count()  # AFTER Runtime: process_count() inits the backend
+ckpt_dir = os.path.join(os.environ["OUT"], "ckpts")
+ckpt = os.path.join(ckpt_dir, "2")
+tree, module = build_tree(runtime, ckpt_dir, resume_from=ckpt)
+attrs = rt.Attributes()
+tree.setup(attrs)
+
+# The canonical reference is the checkpoint FILE itself (template-free
+# read -> flat host numpy). The resharding restore on this topology must
+# reproduce it bitwise.
+from rocket_tpu.runtime import checkpoint_io
+ref = checkpoint_io.load_pytree(os.path.join(ckpt, "model_0"))
+got = flat_state(module)
+assert set(got) <= set(ref), (sorted(got), sorted(ref))
+for name in got:
+    np.testing.assert_array_equal(
+        np.asarray(ref[name]), got[name], err_msg=name)
+assert int(np.asarray(module.state["step"])) == 2
+
+# Training continues mid-epoch from the restored state on THIS topology:
+# the loader fast-forwards the 2 consumed batches and trains the rest.
+run_one_epoch(tree, attrs)
+assert int(np.asarray(module.state["step"])) == 4
+after = flat_state(module)
+np.savez(os.path.join(os.environ["OUT"], f"after_{nproc}.npz"), **after)
+tree.destroy(attrs)
+runtime.wait_for_everyone()
+print(f"RANK{runtime.process_index} RESTORED{nproc} OK", flush=True)
+"""
+
+
+def _spawn_group(nproc, devices_per_proc, script, tmp_path, distributed):
+    port = _free_port()
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update(
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
+            JAX_PLATFORMS="cpu",
+            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            OUT=str(tmp_path),
+        )
+        if distributed:
+            env.update(
+                JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                JAX_NUM_PROCESSES=str(nproc),
+                JAX_PROCESS_ID=str(rank),
+            )
+        else:
+            for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                      "JAX_PROCESS_ID"):
+                env.pop(k, None)
+        env.pop("JAX_PLATFORM_NAME", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        outs.append(out)
+        assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
+    return outs
+
+
+@pytest.mark.slow
+def test_elastic_checkpoint_restore_across_process_counts(tmp_path):
+    """Save under 2 processes; restore (and keep training) under 1 AND
+    under 4. The resharding reader must rebuild identical state from the
+    2-host shard files on every topology, and the 4-process leg doubles
+    as the >2-process smoke test."""
+    import numpy as np
+
+    outs = _spawn_group(2, 2, _ELASTIC_SAVER, tmp_path, distributed=True)
+    assert any("RANK0 SAVED" in o for o in outs)
+
+    # Restore under ONE process (4 local virtual devices, no coordinator).
+    outs = _spawn_group(1, 4, _ELASTIC_RESTORER, tmp_path, distributed=False)
+    assert any("RANK0 RESTORED1 OK" in o for o in outs)
+
+    # Restore under FOUR processes (1 device each -> same 4-device mesh).
+    outs = _spawn_group(4, 1, _ELASTIC_RESTORER, tmp_path, distributed=True)
+    assert any("RANK0 RESTORED4 OK" in o for o in outs)
+
+    # The continued step's result agrees across topologies: same global
+    # batch, same restored state — only the collective reduction order
+    # differs, so tight allclose rather than bitwise.
+    a1 = dict(np.load(tmp_path / "after_1.npz"))
+    a4 = dict(np.load(tmp_path / "after_4.npz"))
+    assert set(a1) == set(a4)
+    for name in a1:
+        np.testing.assert_allclose(
+            a1[name], a4[name], rtol=1e-5, atol=1e-6, err_msg=name)
