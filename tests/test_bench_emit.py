@@ -113,6 +113,30 @@ def test_write_detail_merges_partial_runs(tmp_path):
     assert detail["configs"]["llama"]["value"] == 1234567.8
 
 
+def test_write_detail_errored_rerun_keeps_good_record(tmp_path):
+    """An errored re-run (debug OOM, transient XLA failure) must not
+    destroy a committed good config record — it is annotated instead."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    bench.write_detail(
+        {"gpt2": {"metric": bench.METRIC_NAMES["gpt2"], "error": "OOM" * 200}},
+        path=str(path),
+    )
+    rec = json.loads(path.read_text())["configs"]["gpt2"]
+    assert rec["value"] == 1234567.8          # good record survives
+    assert rec["last_error"].startswith("OOM")
+    assert len(rec["last_error"]) <= 200
+    # A fresh error with NO prior good record still lands as-is.
+    bench.write_detail({"moe": {"metric": "m", "error": "boom"}},
+                       path=str(path))
+    assert json.loads(path.read_text())["configs"]["moe"]["error"] == "boom"
+    # And a later good run replaces the annotated record cleanly.
+    bench.write_detail({"gpt2": dict(_full_result("gpt2"), value=42.0)},
+                       path=str(path))
+    rec = json.loads(path.read_text())["configs"]["gpt2"]
+    assert rec["value"] == 42.0 and "last_error" not in rec
+
+
 def test_write_detail_survives_corrupt_prior(tmp_path):
     path = tmp_path / "BENCH_DETAIL.json"
     for corrupt in ("{not json", "[1,2]", '"a string"', ""):
