@@ -34,6 +34,17 @@ def main(num_epochs: int = 2, batch_size: int = 128, seq_len: int = 256):
     config = TransformerConfig.char_lm(vocab_size=tok.vocab_size, max_seq_len=seq_len)
     model = TransformerLM(config)
 
+    # Persist the architecture next to the checkpoints: param SHAPES are
+    # head-count independent (the fused QKV projection is (D, 3D) for any
+    # split), so a later load under a different preset would succeed and
+    # silently compute a different function. generate.py reads this back.
+    import dataclasses
+    import json
+
+    os.makedirs("checkpoints/char_lm", exist_ok=True)
+    with open("checkpoints/char_lm/config.json", "w") as f:
+        json.dump(dataclasses.asdict(config), f, indent=1)
+
     steps_per_epoch = len(train_data) // batch_size
     total_steps = max(1, steps_per_epoch * num_epochs)
 

@@ -68,9 +68,25 @@ def main():
     # The tokenizer is a pure function of the corpus — rebuild it rather
     # than persisting vocab files.
     tok = CharTokenizer(tiny_shakespeare())
-    config = TransformerConfig.char_lm(
-        vocab_size=tok.vocab_size, max_seq_len=SEQ_LEN
-    )
+    # Architecture comes from the checkpoint dir's config.json when
+    # present: param shapes are head-count independent, so loading params
+    # trained under a different preset would silently sample garbage.
+    import dataclasses
+    import json
+
+    cfg_path = os.path.join(args.ckpt, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            config = TransformerConfig(**json.load(f))
+        print(f"using architecture from {cfg_path} "
+              f"(heads={config.num_heads}, dim={config.dim})")
+    else:
+        config = TransformerConfig.char_lm(
+            vocab_size=tok.vocab_size, max_seq_len=SEQ_LEN
+        )
+        print("no config.json next to the checkpoints — assuming the "
+              f"current char_lm preset (heads={config.num_heads}); "
+              "checkpoints from an older preset will sample garbage")
     model = TransformerLM(config)
 
     params = load_params(model, args.ckpt)

@@ -1,0 +1,111 @@
+"""Decompose the char-LM train step (BASELINE configs[2]) on one chip.
+
+Round-4 left char-LM at ~25-27% MFU with a paragraph ("~50 kernels,
+small matmuls and per-op overheads") where ResNet-50 got a trace-backed
+roofline table — the round-5 ask is the same discipline here: capture a
+``jax.profiler`` trace of the full fused step and attribute the
+on-device time per op (then feed the trace dir to
+``scripts/trace_roofline.py``).
+
+Also times the fused step at several batch sizes and with the candidate
+fusion levers, so "attack or prove the ceiling" decisions ride measured
+wall-clock (summed op durations are NOT wall time — SURVEY §6).
+
+Run on the real TPU: ``python scripts/profile_charlm.py [--trace]
+[--batch N] [--config k=v ...]``.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+V5E_BF16_PEAK = 197e12
+
+
+def sync(x):
+    return float(jnp.asarray(x, jnp.float32))
+
+
+def main():
+    B = 128
+    trace = "--trace" in sys.argv
+    overrides = {}
+    for i, a in enumerate(sys.argv):
+        if a == "--batch":
+            B = int(sys.argv[i + 1])
+        if a == "--config":
+            for kv in sys.argv[i + 1:]:
+                if "=" not in kv:
+                    break
+                k, v = kv.split("=", 1)
+                overrides[k] = eval(v)  # noqa: S307 — operator tool
+
+    import rocket_tpu as rt
+    from rocket_tpu import optim
+    from rocket_tpu.core.module import Module
+    from rocket_tpu.data.text import CharTokenizer, synthetic_corpus
+    from rocket_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, next_token_loss,
+    )
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(seed=0)
+    tok = CharTokenizer(synthetic_corpus(10_000))
+    config = TransformerConfig.char_lm(
+        vocab_size=tok.vocab_size, max_seq_len=256
+    )
+    config.dropout = 0.0
+    for k, v in overrides.items():
+        setattr(config, k, v)
+    T, D, L = config.max_seq_len, config.dim, config.num_layers
+    model = TransformerLM(config)
+    module = Module(
+        model,
+        capsules=[rt.Loss(next_token_loss()),
+                  rt.Optimizer(optim.adamw(), learning_rate=3e-4)],
+        compute_dtype=jnp.bfloat16,
+        runtime=runtime,
+    )
+    module.setup()
+    tokens = np.random.default_rng(0).integers(
+        0, config.vocab_size, (B, T)).astype(np.int32)
+    batch = {"tokens": jax.device_put(tokens)}
+    state = module.prepared.state
+    step = module._train_step
+
+    def run(n, state):
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        return state, metrics
+
+    state, metrics = run(5, state)
+    sync(metrics["loss"])
+    iters = 60
+    t0 = time.perf_counter()
+    state, metrics = run(iters, state)
+    sync(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    n_params = sum(int(l.size) for l in jax.tree.leaves(state["params"]))
+    flops_per_tok = 6 * n_params + 12 * L * T * D
+    tok_s = B * T / dt
+    print(f"B={B} cfg={overrides}: {dt*1e3:.3f} ms/step  {tok_s:,.0f} tok/s  "
+          f"MFU={tok_s*flops_per_tok/V5E_BF16_PEAK:.1%}  "
+          f"({n_params/1e6:.2f}M params)")
+
+    if trace:
+        tdir = "traces/charlm"
+        with jax.profiler.trace(tdir):
+            state, metrics = run(3, state)
+            sync(metrics["loss"])
+        print(f"trace written to {tdir} — summarize with "
+              f"python scripts/trace_roofline.py {tdir}")
+
+
+if __name__ == "__main__":
+    main()
