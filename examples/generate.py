@@ -63,6 +63,9 @@ def main():
     parser.add_argument("--greedy", action="store_true",
                         help="argmax decode (ignores temperature/top-k/p)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--bench", action="store_true",
+                        help="also report decode throughput (tok/s) over a "
+                        "second, timed generation")
     args = parser.parse_args()
 
     # The tokenizer is a pure function of the corpus — rebuild it rather
@@ -116,6 +119,23 @@ def main():
     )
     print("-" * 60)
     print(tok.decode(np.asarray(out[0])))
+
+    if args.bench:
+        # The first call above paid the compile; time a steady-state one.
+        import time
+
+        t0 = time.perf_counter()
+        out2 = generate(
+            model, {"params": params, "state": {}}, prompt, max_new,
+            key=jax.random.key(args.seed + 1),
+            temperature=0.0 if args.greedy else args.temperature,
+            top_k=None if args.greedy else args.top_k,
+            top_p=None if args.greedy else args.top_p,
+        )
+        np.asarray(out2)  # true sync
+        dt = time.perf_counter() - t0
+        print(f"decode: {max_new} tokens in {dt*1e3:.0f} ms = "
+              f"{max_new/dt:,.0f} tok/s (B=1, KV-cached incremental decode)")
 
 
 if __name__ == "__main__":
