@@ -74,7 +74,6 @@ def main():
     # Architecture comes from the checkpoint dir's config.json when
     # present: param shapes are head-count independent, so loading params
     # trained under a different preset would silently sample garbage.
-    import dataclasses
     import json
 
     cfg_path = os.path.join(args.ckpt, "config.json")

@@ -15,7 +15,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("ROCKET_TPU_CACHE", "1")
 
 import jax.numpy as jnp
-import optax
 
 import rocket_tpu as rt
 from rocket_tpu import optim

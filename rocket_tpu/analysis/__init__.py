@@ -1,0 +1,43 @@
+"""rocket_tpu.analysis — static analysis for the fast path.
+
+Two complementary passes plus a runtime strict mode keep the framework's
+performance invariants machine-checked (docs/analysis.md has the full
+rule catalog):
+
+* :mod:`~rocket_tpu.analysis.rocketlint` — AST lint over source files
+  (tracer leaks, jit side effects, capsule lifecycle contract, loop-
+  resident host syncs, fork-after-JAX). CLI:
+  ``python -m rocket_tpu.analysis <paths>``.
+* :mod:`~rocket_tpu.analysis.trace_audit` — jaxpr audit of a concrete
+  step function (donation, host callbacks, weak types, wide dtypes,
+  retrace budget) via abstract evaluation.
+* strict mode — ``Runtime(strict=True)`` (``runtime/context.py``): a
+  ``jax.transfer_guard`` plus a retrace counter enforcing the same
+  contracts on a live run.
+
+Suppress a justified finding inline with ``# rocketlint: disable=RKT1xx``
+(see :mod:`~rocket_tpu.analysis.findings`).
+"""
+
+from rocket_tpu.analysis.findings import Finding, parse_suppressions
+from rocket_tpu.analysis.rocketlint import lint_file, lint_paths, lint_source
+from rocket_tpu.analysis.rules import AST_RULES, AUDIT_RULES, all_rules
+from rocket_tpu.analysis.trace_audit import (
+    audit_retraces,
+    audit_step,
+    trace_signature,
+)
+
+__all__ = [
+    "Finding",
+    "parse_suppressions",
+    "lint_source",
+    "lint_file",
+    "lint_paths",
+    "audit_step",
+    "audit_retraces",
+    "trace_signature",
+    "AST_RULES",
+    "AUDIT_RULES",
+    "all_rules",
+]

@@ -1,0 +1,68 @@
+"""CLI: ``python -m rocket_tpu.analysis <paths...>``.
+
+Lints the given files/directories with every rocketlint rule and exits
+non-zero when unsuppressed findings remain — the shape CI wants
+(``scripts/check.sh`` wires it together with ruff and the tier-1 tests).
+
+The jaxpr-audit rules (RKT2xx) need a concrete step function and example
+inputs, so they run from code/tests via
+:func:`rocket_tpu.analysis.audit_step`, not from this path-based CLI;
+``--list-rules`` documents both families.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from rocket_tpu.analysis.rocketlint import lint_paths
+from rocket_tpu.analysis.rules import all_rules
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.analysis",
+        description="rocketlint: static analysis for rocket_tpu fast paths",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--ignore", default="",
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, slug, contract in all_rules():
+            print(f"{rule_id}  {slug:22s} {contract}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given (or use --list-rules)")
+
+    select = (
+        [r.strip() for r in args.select.split(",") if r.strip()]
+        if args.select else None
+    )
+    ignore = [r.strip() for r in args.ignore.split(",") if r.strip()]
+    try:
+        findings = lint_paths(args.paths, select=select, ignore=ignore)
+    except FileNotFoundError as exc:
+        parser.error(str(exc))
+
+    if args.format == "json":
+        import json
+
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for finding in findings:
+            print(finding.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s).", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Findings and inline suppressions — the shared vocabulary of both passes.
+
+A :class:`Finding` is one analyzer hit: a rule id, a location, and a
+message. The AST linter (``rocketlint``) and the jaxpr auditor
+(``trace_audit``) both emit them, so the CLI, the CI gate and the fixture
+tests consume one shape.
+
+Suppression syntax (mirrors ``# noqa`` / ``# type: ignore``):
+
+* ``# rocketlint: disable=RKT101`` on the flagged line suppresses that
+  rule there (comma-separate several ids; ``disable=all`` silences the
+  line entirely);
+* ``# rocketlint: disable-file=RKT104`` anywhere in a file suppresses the
+  rule for the whole file.
+
+Suppressions are deliberate, reviewable exceptions — the self-gate test
+keeps the framework at zero *unsuppressed* findings, and the suppression
+comment is the audit trail for each justified one.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "Suppressions", "parse_suppressions"]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit."""
+
+    rule: str  # e.g. "RKT101"
+    path: str  # file path, or "<trace:label>" for jaxpr audits
+    line: int  # 1-based; 0 when the finding has no source line
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_DIRECTIVE = re.compile(
+    r"#\s*rocketlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\-\s]+)"
+)
+
+
+@dataclass
+class Suppressions:
+    """Parsed suppression directives for one file."""
+
+    #: line number -> set of rule ids (or {"all"}) disabled on that line
+    by_line: dict = field(default_factory=dict)
+    #: rule ids (or "all") disabled for the whole file
+    file_wide: set = field(default_factory=set)
+
+    def allows(self, finding: Finding) -> bool:
+        """True when the finding survives (is NOT suppressed)."""
+        if "all" in self.file_wide or finding.rule in self.file_wide:
+            return False
+        rules = self.by_line.get(finding.line, ())
+        return not ("all" in rules or finding.rule in rules)
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    """Scan source text for ``# rocketlint: disable[-file]=...`` directives."""
+    sup = Suppressions()
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        match = _DIRECTIVE.search(text)
+        if match is None:
+            continue
+        kind, ids = match.groups()
+        rules = {r.strip() for r in ids.split(",") if r.strip()}
+        if kind == "disable-file":
+            sup.file_wide |= rules
+        else:
+            sup.by_line.setdefault(lineno, set()).update(rules)
+    return sup

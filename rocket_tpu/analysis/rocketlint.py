@@ -1,0 +1,233 @@
+"""rocketlint — AST lint pass over framework and user code.
+
+Static sibling of :mod:`rocket_tpu.analysis.trace_audit`: where the jaxpr
+auditor inspects what a step *traced to*, rocketlint inspects what the
+*source* says, so it catches hazards that never survive into a jaxpr
+(tracer leaks raise at trace time; host syncs in capsule ``launch``
+bodies never enter a jaxpr at all).
+
+The engine parses each file once into a :class:`FileContext` that
+pre-computes the facts every rule needs:
+
+* **jit regions** — ``FunctionDef``s that become traced code: decorated
+  with ``jax.jit`` / ``jit`` (bare or via ``partial``), or referenced by
+  name as the first argument of a ``jax.jit(...)`` / ``shard_map(...)``
+  call anywhere in the module (the framework's dominant idiom:
+  ``self._train_step = jax.jit(train_step, donate_argnums=(0,))``).
+  Nested ``def``s inside a jit region belong to it (lax.cond branches,
+  remat closures).
+* **capsule classes** — classes inheriting (directly, or transitively
+  within the file) from the Capsule family, where the 5-event lifecycle
+  contract applies.
+* parent links and loop membership for every node.
+
+Rules live in :mod:`rocket_tpu.analysis.rules`; findings and the inline
+suppression syntax in :mod:`rocket_tpu.analysis.findings`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional, Sequence
+
+from rocket_tpu.analysis.findings import Finding, parse_suppressions
+
+__all__ = ["FileContext", "lint_source", "lint_file", "lint_paths"]
+
+#: Class names that carry the capsule lifecycle contract. Subclassing any
+#: of these (directly or through a class defined in the same file) makes
+#: the capsule rules apply.
+CAPSULE_BASES = frozenset({
+    "Capsule", "Dispatcher", "Module", "Looper", "Launcher", "Meter",
+    "Metric", "Loss", "Optimizer", "Scheduler", "Tracker", "Checkpointer",
+    "Dataset", "Profiler",
+})
+
+#: The five lifecycle events (Events enum values in core/capsule.py).
+LIFECYCLE_HOOKS = frozenset({"setup", "set", "launch", "reset", "destroy"})
+
+
+def _call_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a call target: ``jax.jit`` -> "jax.jit"."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_WRAPPERS = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pjit", "shard_map", "jax.shard_map",
+    "_shard_map", "jax.checkpoint", "jax.remat",
+})
+
+
+class FileContext:
+    """One parsed file plus the pre-computed facts rules consume."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = parse_suppressions(source)
+
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        self.jit_regions = self._find_jit_regions()
+        #: node -> owning jit-region FunctionDef (covers nested defs)
+        self._jit_nodes: dict[int, ast.FunctionDef] = {}
+        for region in self.jit_regions:
+            for node in ast.walk(region):
+                self._jit_nodes.setdefault(id(node), region)
+
+        self.capsule_classes = self._find_capsule_classes()
+
+    # -- fact builders ----------------------------------------------------
+
+    def _find_jit_regions(self) -> list[ast.FunctionDef]:
+        traced_names: set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name in _JIT_WRAPPERS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    traced_names.add(first.id)
+
+        regions = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in traced_names or self._has_jit_decorator(node):
+                regions.append(node)
+        return regions
+
+    @staticmethod
+    def _has_jit_decorator(node: ast.FunctionDef) -> bool:
+        for deco in node.decorator_list:
+            name = _call_name(deco)
+            if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                return True
+            if isinstance(deco, ast.Call):
+                name = _call_name(deco.func)
+                if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                    return True
+                # partial(jax.jit, ...) / functools.partial(jit, ...)
+                if name in ("partial", "functools.partial") and deco.args:
+                    if _call_name(deco.args[0]) in ("jax.jit", "jit"):
+                        return True
+        return False
+
+    def _find_capsule_classes(self) -> list[ast.ClassDef]:
+        by_name = {
+            node.name: node
+            for node in ast.walk(self.tree)
+            if isinstance(node, ast.ClassDef)
+        }
+
+        def is_capsule(cls: ast.ClassDef, seen: frozenset = frozenset()) -> bool:
+            for base in cls.bases:
+                name = _call_name(base)
+                if name is None:
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                if tail in CAPSULE_BASES:
+                    return True
+                local = by_name.get(tail)
+                if local is not None and tail not in seen:
+                    if is_capsule(local, seen | {tail}):
+                        return True
+            return False
+
+        return [cls for cls in by_name.values() if is_capsule(cls)]
+
+    # -- queries -----------------------------------------------------------
+
+    def in_jit_region(self, node: ast.AST) -> bool:
+        return id(node) in self._jit_nodes
+
+    def enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest For/While ancestor within the same function, or None."""
+        cursor = self.parents.get(node)
+        while cursor is not None:
+            if isinstance(cursor, (ast.For, ast.While, ast.AsyncFor)):
+                return cursor
+            if isinstance(cursor, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                return None
+            cursor = self.parents.get(cursor)
+        return None
+
+    def walk_calls(self) -> Iterable[ast.Call]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def lint_source(path: str, source: str,
+                select: Optional[Sequence[str]] = None,
+                ignore: Sequence[str] = ()) -> list[Finding]:
+    """Lint one source blob; returns unsuppressed findings, sorted."""
+    from rocket_tpu.analysis.rules import AST_RULES
+
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as exc:
+        return [Finding("RKT100", path, exc.lineno or 0,
+                        f"syntax error: {exc.msg}")]
+
+    findings: list[Finding] = []
+    for rule in AST_RULES:
+        if select is not None and rule.rule_id not in select:
+            continue
+        if rule.rule_id in ignore:
+            continue
+        findings.extend(rule.check(ctx))
+    findings = [f for f in findings if ctx.suppressions.allows(f)]
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+def lint_file(path: str, select: Optional[Sequence[str]] = None,
+              ignore: Sequence[str] = ()) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(path, source, select=select, ignore=ignore)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git") and not d.startswith(".")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        else:
+            # A typoed path silently linting zero files would read as a
+            # clean CI pass — fail loudly instead.
+            raise FileNotFoundError(f"rocketlint: no such file or directory: {path!r}")
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Sequence[str] = ()) -> list[Finding]:
+    """Lint files/directories; directories recurse over ``*.py``."""
+    findings: list[Finding] = []
+    for file_path in iter_python_files(paths):
+        findings.extend(lint_file(file_path, select=select, ignore=ignore))
+    return findings

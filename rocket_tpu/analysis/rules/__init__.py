@@ -1,0 +1,66 @@
+"""Rule registry for rocketlint (AST) and the trace auditor (jaxpr).
+
+Every rule has a stable id (``RKT1xx`` = AST lint, ``RKT2xx`` = jaxpr
+audit), a short slug, and a one-line contract used by ``--list-rules``
+and docs/analysis.md. AST rules expose ``check(ctx) -> Iterable[Finding]``
+over a :class:`~rocket_tpu.analysis.rocketlint.FileContext`; jaxpr rules
+are applied by :mod:`rocket_tpu.analysis.trace_audit` and are listed here
+for the catalog only.
+"""
+
+from __future__ import annotations
+
+from rocket_tpu.analysis.rules.capsule_rules import (
+    CapsuleSuperRule,
+    HandlerSignatureRule,
+    LaunchHostSyncRule,
+)
+from rocket_tpu.analysis.rules.host_rules import (
+    ForkStartMethodRule,
+    SyncInLoopRule,
+)
+from rocket_tpu.analysis.rules.jit_rules import (
+    JitSideEffectRule,
+    TracerLeakRule,
+)
+
+__all__ = ["AST_RULES", "AUDIT_RULES", "all_rules"]
+
+#: AST rules, run by rocketlint in id order.
+AST_RULES = (
+    TracerLeakRule(),
+    JitSideEffectRule(),
+    SyncInLoopRule(),
+    CapsuleSuperRule(),
+    HandlerSignatureRule(),
+    LaunchHostSyncRule(),
+    ForkStartMethodRule(),
+)
+
+#: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.
+AUDIT_RULES = (
+    ("RKT201", "donation-unused",
+     "donated argument buffer matches no output: the donation is wasted "
+     "(XLA copies instead of aliasing)"),
+    ("RKT202", "donation-duplicate",
+     "the same buffer appears at two donated leaves: double-donation is "
+     "undefined behavior at dispatch"),
+    ("RKT203", "host-callback-in-step",
+     "a host callback (pure_callback/io_callback/debug.print) is traced "
+     "into the compiled step: device-to-host sync every step"),
+    ("RKT204", "weak-type-input",
+     "a step input traced with weak_type=True (Python scalar leaked into "
+     "the signature): dtype promotion drift and one retrace per call site"),
+    ("RKT205", "retrace-excess",
+     "the example inputs produce more distinct trace signatures than "
+     "max_traces: every new shape/dtype recompiles the step"),
+    ("RKT206", "wide-dtype",
+     "a float64/complex128 value flows through the step: silent 64-bit "
+     "upcast (unsupported or slow on TPU)"),
+)
+
+
+def all_rules():
+    """(id, slug, contract) for every rule, AST + audit, in id order."""
+    ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
+    return tuple(sorted(ast_meta + list(AUDIT_RULES)))

@@ -1,0 +1,172 @@
+"""Rules over Capsule subclasses: the 5-event lifecycle contract.
+
+``setup``/``destroy`` maintain the runtime's checkpoint stack (LIFO,
+identity-checked — core/capsule.py); an override that forgets ``super()``
+silently drops the capsule from checkpointing or corrupts the stack for
+everyone destroyed after it. ``dispatch`` calls every handler as
+``handler(attrs)``, so a handler with any other signature raises
+TypeError only at dispatch time, deep in a run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["CapsuleSuperRule", "HandlerSignatureRule", "LaunchHostSyncRule"]
+
+
+def _call_name(node: ast.AST):
+    from rocket_tpu.analysis.rocketlint import _call_name as impl
+
+    return impl(node)
+
+
+#: Hooks whose base implementation is load-bearing (checkpoint stack).
+_SUPER_REQUIRED_HOOKS = ("setup", "destroy")
+
+
+def _calls_base_hook(func: ast.FunctionDef, hook: str) -> bool:
+    """True when the body calls ``super().<hook>(...)`` or an explicit
+    ``SomeBase.<hook>(self, ...)``."""
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        target = node.func
+        if not (isinstance(target, ast.Attribute) and target.attr == hook):
+            continue
+        owner = target.value
+        if isinstance(owner, ast.Call) and _call_name(owner.func) == "super":
+            return True
+        if isinstance(owner, ast.Name):
+            # Explicit-base form requires passing self as first argument.
+            if node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == "self":
+                return True
+    return False
+
+
+class CapsuleSuperRule:
+    rule_id = "RKT104"
+    slug = "capsule-super"
+    contract = (
+        "a Capsule subclass overrides setup/destroy without calling "
+        "super(): the capsule drops out of the checkpoint stack (or "
+        "corrupts its LIFO unwind)"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for cls in ctx.capsule_classes:
+            for node in cls.body:
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                if node.name not in _SUPER_REQUIRED_HOOKS:
+                    continue
+                if not _calls_base_hook(node, node.name):
+                    yield Finding(
+                        self.rule_id, ctx.path, node.lineno,
+                        f"{cls.name}.{node.name} overrides a lifecycle hook "
+                        f"without calling super().{node.name}(attrs) — the "
+                        "base maintains the runtime checkpoint stack",
+                    )
+
+
+class HandlerSignatureRule:
+    rule_id = "RKT105"
+    slug = "handler-signature"
+    contract = (
+        "a lifecycle handler (setup/set/launch/reset/destroy) does not "
+        "accept exactly (self, attrs): dispatch() calls handler(attrs) "
+        "and anything else is a TypeError mid-run"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        from rocket_tpu.analysis.rocketlint import LIFECYCLE_HOOKS
+
+        for cls in ctx.capsule_classes:
+            for node in cls.body:
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                if node.name not in LIFECYCLE_HOOKS:
+                    continue
+                if any(_call_name(d) == "staticmethod"
+                       for d in node.decorator_list):
+                    continue  # not the instance-dispatch surface
+                args = node.args
+                names = [a.arg for a in args.posonlyargs + args.args]
+                n_defaults = len(args.defaults)
+                required = len(names) - n_defaults
+                # dispatch() invokes handler(attrs): callable iff at most
+                # (self, attrs) are required, attrs has somewhere to land
+                # (a second positional or *args), and any kw-only params
+                # carry defaults. Extra defaulted params are fine.
+                ok = (
+                    bool(names)
+                    and names[0] == "self"
+                    and required <= 2
+                    and (len(names) >= 2 or args.vararg is not None)
+                    and all(d is not None for d in args.kw_defaults)
+                )
+                if not ok:
+                    sig = ", ".join(names)
+                    if args.vararg:
+                        sig += ", *" + args.vararg.arg
+                    if args.kwarg:
+                        sig += ", **" + args.kwarg.arg
+                    yield Finding(
+                        self.rule_id, ctx.path, node.lineno,
+                        f"{cls.name}.{node.name}({sig}) cannot be invoked "
+                        f"as handler(attrs) — dispatch() calls lifecycle "
+                        "handlers with exactly one positional argument",
+                    )
+
+
+#: Call shapes that force a device->host sync.
+_SYNC_BUILTINS = frozenset({"float"})
+_SYNC_CALLS = frozenset({
+    "jax.device_get", "jax.block_until_ready",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "multihost_utils.process_allgather",
+})
+_SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+
+class LaunchHostSyncRule:
+    rule_id = "RKT106"
+    slug = "launch-host-sync"
+    contract = (
+        "a capsule launch() body performs a device->host sync "
+        "(float()/np.asarray()/.item()/device_get): launch runs every "
+        "iteration, so this stalls the dispatch pipeline each step"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for cls in ctx.capsule_classes:
+            for node in cls.body:
+                if not (isinstance(node, ast.FunctionDef)
+                        and node.name == "launch"):
+                    continue
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    name = _call_name(call.func)
+                    hit = None
+                    if name in _SYNC_BUILTINS and call.args \
+                            and not isinstance(call.args[0], ast.Constant):
+                        hit = f"{name}()"
+                    elif name in _SYNC_CALLS:
+                        hit = f"{name}()"
+                    elif (
+                        isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _SYNC_METHODS
+                    ):
+                        hit = f".{call.func.attr}()"
+                    if hit:
+                        yield Finding(
+                            self.rule_id, ctx.path, call.lineno,
+                            f"{hit} in {cls.name}.launch syncs device->host "
+                            "every iteration; accumulate device scalars and "
+                            "materialize at epoch/flush boundaries",
+                        )

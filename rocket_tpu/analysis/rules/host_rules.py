@@ -1,0 +1,93 @@
+"""Host-side hazard rules: loop-resident syncs and fork-after-JAX.
+
+These run over *all* code (not just jit regions / capsule classes): the
+training loop's host side is exactly where a stray ``device_get`` or an
+``os.fork()`` from a multithreaded JAX parent costs the most.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["SyncInLoopRule", "ForkStartMethodRule"]
+
+
+def _call_name(node: ast.AST):
+    from rocket_tpu.analysis.rocketlint import _call_name as impl
+
+    return impl(node)
+
+
+_LOOP_SYNC_CALLS = frozenset({
+    "jax.device_get", "jax.block_until_ready",
+    "multihost_utils.process_allgather",
+})
+
+
+class SyncInLoopRule:
+    rule_id = "RKT103"
+    slug = "sync-in-loop"
+    contract = (
+        "jax.device_get / block_until_ready inside a for/while loop: a "
+        "device round-trip per iteration serializes host and device "
+        "(loop-resident code must stay async)"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for call in ctx.walk_calls():
+            if ctx.in_jit_region(call):
+                continue  # cannot trace these anyway; RKT101 owns that
+            if ctx.enclosing_loop(call) is None:
+                continue
+            name = _call_name(call.func)
+            hit = None
+            if name in _LOOP_SYNC_CALLS:
+                hit = f"{name}()"
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "block_until_ready"
+            ):
+                hit = ".block_until_ready()"
+            if hit:
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"{hit} inside a loop forces a device sync every "
+                    "iteration; hoist it past the loop or batch the reads",
+                )
+
+
+class ForkStartMethodRule:
+    rule_id = "RKT107"
+    slug = "fork-start-method"
+    contract = (
+        "os.fork / multiprocessing start method 'fork' in a process that "
+        "may have initialized JAX: forking a multithreaded parent can "
+        "deadlock the child on an inherited lock"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for call in ctx.walk_calls():
+            name = _call_name(call.func)
+            if name in ("os.fork", "os.forkpty"):
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"{name}() from a (potentially multithreaded) JAX "
+                    "process risks a child deadlock; prefer spawn/"
+                    "forkserver process creation",
+                )
+                continue
+            if name is None or name.rsplit(".", 1)[-1] not in (
+                "get_context", "set_start_method"
+            ):
+                continue
+            for arg in call.args:
+                if isinstance(arg, ast.Constant) and arg.value == "fork":
+                    yield Finding(
+                        self.rule_id, ctx.path, call.lineno,
+                        "start method 'fork' inherits the JAX parent's "
+                        "threads' lock state; default to forkserver/spawn "
+                        "and make 'fork' an explicit user opt-in",
+                    )

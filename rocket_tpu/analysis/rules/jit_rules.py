@@ -1,0 +1,111 @@
+"""Rules over jit regions: code that becomes part of a traced step.
+
+A "jit region" (see :class:`~rocket_tpu.analysis.rocketlint.FileContext`)
+is a function that jax traces: anything it does on its array arguments
+happens to *tracers*, and anything it does besides returning arrays
+happens *once at trace time*, not per step.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["TracerLeakRule", "JitSideEffectRule"]
+
+
+def _call_name(node: ast.AST):
+    from rocket_tpu.analysis.rocketlint import _call_name as impl
+
+    return impl(node)
+
+
+#: Builtins that force a tracer to a host value (ConcretizationTypeError
+#: at trace time, or a silent constant if applied to a closed-over array).
+_LEAK_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+
+#: numpy entry points that materialize a tracer on host.
+_LEAK_NUMPY = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.float32", "np.float64", "np.int32", "np.int64",
+})
+
+#: Methods that force a device round-trip on whatever they are called on.
+_LEAK_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+class TracerLeakRule:
+    rule_id = "RKT101"
+    slug = "tracer-leak"
+    contract = (
+        "float()/int()/bool()/np.asarray()/.item() applied inside a jit "
+        "region forces the traced value to host: ConcretizationTypeError "
+        "at best, a silently baked-in constant at worst"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for call in ctx.walk_calls():
+            if not ctx.in_jit_region(call):
+                continue
+            name = _call_name(call.func)
+            hit = None
+            if name in _LEAK_BUILTINS and len(call.args) == 1:
+                # float(x) on a literal/len() is fine; only flag when the
+                # operand could plausibly be traced (a Name, call result,
+                # subscript or attribute — not a constant).
+                if not isinstance(call.args[0], ast.Constant):
+                    hit = f"{name}()"
+            elif name in _LEAK_NUMPY:
+                hit = f"{name}()"
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in _LEAK_METHODS
+            ):
+                hit = f".{call.func.attr}()"
+            if hit:
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"{hit} inside a jit-traced function leaks the tracer "
+                    "to host; keep the value symbolic (jnp ops) or compute "
+                    "it outside the step",
+                )
+
+
+#: Call targets that are host side effects: traced once, then silently
+#: absent from the compiled step (or a hidden host sync via callbacks).
+_SIDE_EFFECT_CALLS = frozenset({"print", "open", "input"})
+_HOST_RNG_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+class JitSideEffectRule:
+    rule_id = "RKT102"
+    slug = "jit-side-effect"
+    contract = (
+        "Python side effects (print/open/host RNG) inside a jit region "
+        "run once at trace time, not per step — prints vanish, host RNG "
+        "draws become baked-in constants"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for call in ctx.walk_calls():
+            if not ctx.in_jit_region(call):
+                continue
+            name = _call_name(call.func)
+            if name is None:
+                continue
+            if name in _SIDE_EFFECT_CALLS:
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"{name}() inside a jit-traced function executes at "
+                    "trace time only; use jax.debug.print / io_callback "
+                    "deliberately if a per-step effect is intended",
+                )
+            elif name.startswith(_HOST_RNG_PREFIXES):
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"host RNG {name}() inside a jit-traced function draws "
+                    "ONCE at trace time and becomes a constant; thread a "
+                    "jax.random key instead",
+                )

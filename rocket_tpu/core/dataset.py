@@ -48,7 +48,7 @@ class Dataset(Capsule):
         cache_dtype=None,
         fuse_gather: bool = True,
         num_workers: int = 0,
-        worker_start_method: str = "fork",
+        worker_start_method: Optional[str] = None,
         prefetch: int = 2,
         statefull: bool = True,
         priority: int = 1000,
@@ -59,10 +59,11 @@ class Dataset(Capsule):
         # num_workers: multiprocess batch loading on the STREAMING path
         # (torch DataLoader(num_workers=N) parity, reference
         # dataset.py:52-57); the device-resident cache path has no per-step
-        # host work and ignores it. worker_start_method: "fork" (default)
-        # inherits the dataset copy-on-write but forks from a multi-threaded
-        # parent — if a lock held by another library at fork time deadlocks
-        # a worker, pass "spawn" (pickles the dataset into each worker once).
+        # host work and ignores it. worker_start_method: None (default) ->
+        # forkserver/spawn (pickles the dataset into each worker once,
+        # never os.fork()s the multithreaded JAX parent); "fork" stays
+        # selectable for unpicklable datasets — copy-on-write inheritance,
+        # accepting the documented deadlock risk (rocketlint RKT107).
         self._loader_kwargs = dict(
             batch_size=batch_size,
             shuffle=shuffle,

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
+import jax
+
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.core.dispatcher import Dispatcher
@@ -148,10 +150,21 @@ class Looper(Dispatcher):
         bar = self._progress_bar()
         start = self._batch_idx  # >0 only on mid-epoch resume
         try:
-            for _ in range(start, self._repeats):
+            for it in range(start, self._repeats):
                 attrs.batch = None
                 attrs.mode = self.mode
-                Dispatcher.launch(self, attrs)
+                # Strict mode clamps the iteration wave — the steady-state
+                # hot path — under a full transfer guard: any IMPLICIT
+                # host<->device transfer a capsule sneaks into the loop
+                # (float(scalar), numpy into jit) raises at the offending
+                # line. Explicit device_put/device_get stay legal. The
+                # FIRST wave of the epoch runs unguarded: it compiles the
+                # step, and loading the executable uploads its embedded
+                # constants (an implicit H2D by design); from the second
+                # wave on the shapes are stable — wrap padding guarantees
+                # it — and everything implicit is a genuine leak.
+                with self._iteration_guard(warmup=(it == start)):
+                    Dispatcher.launch(self, attrs)
                 if attrs.looper is not None and attrs.looper.terminate:
                     break
                 self._batch_idx += 1
@@ -162,8 +175,13 @@ class Looper(Dispatcher):
                         and attrs.looper is not None
                         and attrs.looper.state
                     ):
+                        # Deliberate, throttled sync: formatting the postfix
+                        # reads device scalars. device_get keeps it an
+                        # EXPLICIT transfer (strict-mode transfer_guard
+                        # allows it); postfix_every bounds the cost.
                         bar.set_postfix(
-                            {k: f"{float(v):.4g}" for k, v in attrs.looper.state.items()},
+                            {k: f"{float(jax.device_get(v)):.4g}"  # rocketlint: disable=RKT103,RKT106
+                             for k, v in attrs.looper.state.items()},
                             refresh=False,
                         )
         finally:
@@ -183,6 +201,19 @@ class Looper(Dispatcher):
             attrs.looper = None
 
     # -- helpers -----------------------------------------------------------
+
+    def _iteration_guard(self, warmup: bool = False):
+        """Transfer guard for one iteration wave (strict mode), else a
+        no-op context."""
+        import contextlib
+
+        if (
+            not warmup
+            and self._runtime is not None
+            and self._runtime.strict.enabled
+        ):
+            return jax.transfer_guard(self._runtime.strict.transfer_guard)
+        return contextlib.nullcontext()
 
     def _infer_repeats(self) -> Optional[int]:
         """Sum child Dataset totals (loop.py:113-125)."""

@@ -24,7 +24,8 @@ from __future__ import annotations
 
 from typing import Callable
 
-import jax.numpy as jnp
+import jax
+import numpy as np
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import PRIORITY_LOSS, Capsule
@@ -77,7 +78,9 @@ class Loss(Capsule):
     # -- checkpoint state --------------------------------------------------
 
     def state_dict(self) -> dict:
-        return {"value": float(jnp.asarray(self._value))}
+        # Explicit transfer (strict-mode legal): checkpoint time is the
+        # one place the running value must materialize on host.
+        return {"value": float(np.asarray(jax.device_get(self._value)))}
 
     def load_state_dict(self, state: dict) -> None:
         self._value = float(state["value"])

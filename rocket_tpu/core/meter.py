@@ -110,8 +110,14 @@ class Meter(Dispatcher):
         # metric — no full-tensor gather and no per-batch D2H sync (the
         # metric materializes once per epoch in reset()). Host numpy batches
         # take the same path — jit accepts numpy inputs.
-        import jax.numpy as jnp
-
+        # Shared per-batch operands for ALL device-reducing children,
+        # built lazily on the first one. Fast path: the host size scalar
+        # uploads during the jit dispatch itself (no extra device_put —
+        # a put is real latency through a tunneled runtime). Strict
+        # mode's loop guard forbids that implicit upload, so it pays for
+        # ONE explicit put per batch, replicated so jit needs no
+        # follow-up reshard.
+        subset = size_arr = None
         host_kids = []
         for child in self._capsules:
             if (
@@ -123,9 +129,23 @@ class Meter(Dispatcher):
                     fn = self._reduce_fns[id(child)] = jax.jit(
                         child.device_reduce
                     )
-                subset = {k: batch[k] for k in self._keys}
-                size = len(batch[self._keys[0]]) if real_size is None else real_size
-                child.consume(fn(subset, jnp.asarray(size, jnp.int32)))
+                if subset is None:
+                    subset = {k: batch[k] for k in self._keys}
+                    size = (
+                        len(batch[self._keys[0]])
+                        if real_size is None else real_size
+                    )
+                    size_arr = np.int32(size)
+                    if (
+                        self._runtime is not None
+                        and self._runtime.strict.enabled
+                    ):
+                        size_arr = jax.device_put(
+                            size_arr,
+                            self._runtime.replicated
+                            if jax.device_count() > 1 else None,
+                        )
+                child.consume(fn(subset, size_arr))
             else:
                 host_kids.append(child)
         if not host_kids:

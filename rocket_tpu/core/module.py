@@ -25,7 +25,7 @@ ICI collectives.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,6 @@ import optax
 
 from rocket_tpu import optim as optim_lib
 from rocket_tpu.core.attributes import Attributes
-from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.core.dispatcher import Dispatcher
 
 __all__ = ["Module", "PreparedModule"]
@@ -645,6 +644,21 @@ class Module(Dispatcher):
             attrs.sync_gradients = (self._host_step % accum) == 0
             outputs = metrics.pop("outputs", None)
             attrs.step_metrics = Attributes(metrics)
+            strict = self._runtime.strict
+            if strict.enabled:
+                # Retrace budget: a host-side cache-size read (no device
+                # op); surfaced through the Tracker so a creeping recompile
+                # shows up on the dashboard before it eats the run.
+                retraces = strict.note_retraces(
+                    f"train_step[{type(self._model).__name__}]",
+                    self._train_step,
+                )
+                if (
+                    retraces is not None  # None: no compile-cache probe
+                    and attrs.tracker is not None
+                    and attrs.sync_gradients
+                ):
+                    attrs.tracker.scalars["retraces"] = retraces
             if outputs is not None:
                 attrs.batch = _strip_marker(_merge_batch(outputs, static))
         else:

@@ -23,8 +23,9 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Optional
+from typing import Optional
 
+import jax
 import numpy as np
 
 from rocket_tpu.core.attributes import Attributes
@@ -229,16 +230,23 @@ class Tracker(Capsule):
         if attrs.looper is not None:
             tag = attrs.looper.tag
         if self._backend is not None:
+            # ONE device_get per buffer dict, not one per value: the flush
+            # is THE deliberate materialization point for the buffered
+            # device scalars, a batched explicit transfer keeps it to a
+            # single device round trip, and explicit transfers stay legal
+            # under StrictMode's guard.
             if scalars:
+                host = jax.device_get(dict(scalars))
                 host_scalars = {
                     (f"{tag}/{k}" if tag else k): float(np.asarray(v))
-                    for k, v in scalars.items()
+                    for k, v in host.items()
                 }
                 self._backend.log_scalars(host_scalars, self._iter_idx)
             if images:
+                host = jax.device_get(dict(images))
                 host_images = {
                     (f"{tag}/{k}" if tag else k): np.asarray(v)
-                    for k, v in images.items()
+                    for k, v in host.items()
                 }
                 self._backend.log_images(host_images, self._iter_idx)
         # Reset buffers, bump the global step (tracker.py:114-117).

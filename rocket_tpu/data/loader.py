@@ -75,7 +75,7 @@ class DataLoader:
         process_index: int = 0,
         process_count: int = 1,
         num_workers: int = 0,
-        worker_start_method: str = "fork",
+        worker_start_method: Optional[str] = None,
     ) -> None:
         if batch_size < 1:
             raise ValueError(f"DataLoader: batch_size must be >= 1, got {batch_size}")
@@ -103,14 +103,13 @@ class DataLoader:
             )
         # Multiprocess batch loading (torch num_workers parity, reference
         # dataset.py:52-57) — map-style only (workers need random access).
-        # worker_start_method: "fork" (default, torch's Linux model — the
-        # dataset is inherited copy-on-write, never pickled) or "spawn".
-        # CAVEAT (round-3 advisor): fork happens from a multi-threaded
-        # parent (jax runtime threads are already running); jax itself is
-        # never called in workers, but any OTHER lock held at fork time
-        # (logging handlers, user library threads touched by __getitem__)
-        # can deadlock a worker — switch to "spawn" if workers hang, at the
-        # cost of pickling the dataset into each worker once.
+        # worker_start_method: None (default) -> forkserver/spawn — the
+        # dataset is pickled into each worker once and the multithreaded
+        # JAX parent is never os.fork()ed (a fork can deadlock a worker on
+        # any lock held at fork time; round-3 advisor + rocketlint RKT107).
+        # "fork" stays selectable for unpicklable datasets (closures, mmap
+        # handles): copy-on-write inheritance, torch's Linux model,
+        # accepting the deadlock risk.
         self.num_workers = int(num_workers)
         self.worker_start_method = worker_start_method
         if self.num_workers and not self._map_style:

@@ -6,10 +6,16 @@ GIL-bound on one thread; at ImageNet-scale decode rates a single Python
 worker starves the chip. This pool runs batch loads in ``num_workers``
 OS processes:
 
-* **fork start method**: workers inherit the dataset by copy-on-write at
-  pool creation — the dataset object is never pickled, matching torch's
-  worker model (and keeping closures/mmap-backed datasets cheap). Workers
-  touch only host data (numpy); they must never call jax;
+* **start method**: ``forkserver`` where available, else ``spawn`` (the
+  default, ``start_method=None``). By pool-creation time the parent is
+  multithreaded — the JAX runtime threads are up — and ``os.fork()`` from
+  a multithreaded parent can deadlock the child on any lock held at fork
+  time (JAX itself warns exactly this). Both defaults create workers
+  without forking the JAX parent, at the cost of pickling the dataset
+  into each worker once. ``start_method="fork"`` stays selectable for
+  unpicklable datasets (closures, mmap handles) — torch's Linux model,
+  copy-on-write, no pickling — accepting the documented deadlock risk
+  (rocketlint RKT107 flags it);
 * **ordered lookahead**: batch index lists are submitted ``2*num_workers``
   deep and results consumed in submission order, so batch order is
   deterministic and identical to the serial path (same shuffle, same wrap
@@ -34,10 +40,18 @@ import numpy as np
 
 __all__ = ["WorkerPool"]
 
-# Worker-process globals, set once by the pool initializer (inherited via
-# fork — never pickled).
+# Worker-process globals, set once by the pool initializer (pickled into
+# the worker at creation under spawn/forkserver; inherited under fork).
 _WORKER_DATASET: Any = None
 _WORKER_COLLATE: Optional[Callable] = None
+
+
+def default_start_method() -> str:
+    """``forkserver`` where the platform offers it (POSIX), else ``spawn``
+    — both avoid ``os.fork()`` from the multithreaded JAX parent."""
+    if "forkserver" in multiprocessing.get_all_start_methods():
+        return "forkserver"
+    return "spawn"
 
 
 def _init_worker(dataset, collate, seed: int, counter) -> None:
@@ -74,20 +88,24 @@ class WorkerPool:
     """
 
     def __init__(self, dataset, collate, num_workers: int,
-                 start_method: str = "fork", seed: int = 0) -> None:
+                 start_method: Optional[str] = None, seed: int = 0) -> None:
         if num_workers < 1:
             raise ValueError(
                 f"WorkerPool: num_workers must be >= 1, got {num_workers}"
             )
         self._num_workers = num_workers
-        # "fork" inherits the dataset copy-on-write (no pickling, torch's
-        # Linux model). The parent is multi-threaded by the time a pool
-        # exists (jax runtime threads): workers never call jax so ITS locks
-        # are never taken, but any other lock held at fork time (logging
-        # handlers, user library threads reached by __getitem__) can
-        # deadlock a worker. start_method="spawn" — selectable from
-        # Dataset/DataLoader(worker_start_method=...) — gives full
-        # isolation at the cost of pickling the dataset into each worker.
+        # None -> forkserver/spawn (see module docstring): workers are
+        # created without os.fork()-ing the multithreaded JAX parent, so
+        # no lock held at fork time (logging handlers, user library
+        # threads reached by __getitem__) can deadlock a worker — and
+        # JAX's "os.fork() is incompatible with multithreaded code"
+        # warning stays silent (asserted in tests/test_data.py).
+        # "fork" — selectable from Dataset/DataLoader(
+        # worker_start_method=...) — inherits the dataset copy-on-write
+        # for closures/mmap-backed datasets that cannot pickle.
+        if start_method is None:
+            start_method = default_start_method()
+        self.start_method = start_method
         ctx = multiprocessing.get_context(start_method)
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers,

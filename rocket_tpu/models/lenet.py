@@ -4,7 +4,6 @@ rebuilt NHWC/TPU-native."""
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 from rocket_tpu import nn
 

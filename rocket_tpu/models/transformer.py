@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
-from rocket_tpu import nn
 from rocket_tpu.nn.attention import MultiHeadAttention
 from rocket_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm, RMSNorm
 from rocket_tpu.nn.module import Layer, Model, Variables

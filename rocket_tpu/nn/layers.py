@@ -9,11 +9,10 @@ precision when the activations are bfloat16).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Union
+from typing import Callable, Sequence, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from rocket_tpu.nn.module import Layer, Lambda
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 
 __all__ = ["Layer", "Sequential", "Lambda", "Model", "Variables", "merge_state"]
 

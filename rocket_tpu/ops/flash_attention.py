@@ -468,10 +468,7 @@ def flash_attention_qkv_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax >= 0.8
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from rocket_tpu.utils.compat import shard_map as _shard_map
 
     _, b, h, t, d = qkv.shape
     baxes, haxis = shardable_axes(mesh, b, h, batch_axes, head_axis)

@@ -766,12 +766,8 @@ def flash_fused_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax >= 0.8
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
-
     from rocket_tpu.ops.flash_attention import shardable_axes
+    from rocket_tpu.utils.compat import shard_map as _shard_map
 
     b, t, f = fused.shape
     if f % (3 * num_heads):
@@ -834,12 +830,8 @@ def flash_bthd_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax >= 0.8
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
-
     from rocket_tpu.ops.flash_attention import shardable_axes
+    from rocket_tpu.utils.compat import shard_map as _shard_map
 
     if num_kv_heads is None:
         num_kv_heads = num_heads

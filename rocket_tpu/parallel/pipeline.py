@@ -38,10 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from rocket_tpu.parallel.collectives import pvary_compat
 
-try:  # jax >= 0.8 moved shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from rocket_tpu.utils.compat import shard_map
 
 __all__ = ["pipeline_blocks", "pipeline_train_1f1b"]
 

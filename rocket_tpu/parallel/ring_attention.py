@@ -23,10 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8 moved shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from rocket_tpu.utils.compat import shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 

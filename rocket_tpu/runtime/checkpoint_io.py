@@ -140,7 +140,11 @@ def snapshot(tree: Any) -> dict:
                     {"file": _shard_file(owner), "key": key, "index": list(idx)}
                 )
                 if owner == process:
-                    local[key] = np.asarray(by_device[idx].data)
+                    # Explicit D2H pull — the checkpoint snapshot is THE
+                    # deliberate materialization point (checkpoint time,
+                    # not the hot path), and device_get stays legal under
+                    # StrictMode's transfer guard.
+                    local[key] = np.asarray(jax.device_get(by_device[idx].data))  # rocketlint: disable=RKT103
             index[name] = {
                 "kind": "array",
                 "shape": list(leaf.shape),

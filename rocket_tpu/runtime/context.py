@@ -28,7 +28,93 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["Runtime", "IdentityRegistry"]
+__all__ = ["Runtime", "IdentityRegistry", "StrictMode"]
+
+
+class StrictMode:
+    """Opt-in runtime enforcement of the fast-path contracts that
+    ``rocket_tpu.analysis`` checks statically (docs/analysis.md).
+
+    Two teeth:
+
+    * a **transfer guard**, in two layers. Globally (run-wide), implicit
+      *device-to-host* transfers are set to ``transfer_guard`` (default
+      ``"disallow"``): a stray ``float(device_scalar)`` raises at the
+      offending line instead of silently stalling every step. Inside the
+      Looper's per-iteration wave — the steady-state hot path — ALL
+      implicit transfer directions are clamped (``Looper.launch``), so a
+      numpy batch sneaking into jit per step raises too. Host-to-device
+      is not guarded globally because init/setup legitimately create
+      arrays (``jnp.ones`` is an implicit H2D). Explicit
+      ``jax.device_put`` / ``jax.device_get`` — the framework's own
+      transfer points — stay legal everywhere. CAVEAT: on CPU backends
+      device memory IS host memory, so jax does not guard D2H reads
+      there — the run-wide layer only bites on real accelerators; the
+      loop-wave guard (H2D included) is what enforces on a CPU dev box;
+    * a **retrace counter**: :meth:`note_retraces` reads a jitted step's
+      compile-cache size and raises once it exceeds ``max_retraces`` —
+      shape-unstable callers fail loudly instead of silently spending the
+      run in XLA. The count is surfaced through the Tracker as a
+      ``retraces`` scalar (see ``core/module.py``).
+
+    Enable via ``Runtime(strict=True)`` or ``ROCKET_TPU_STRICT=1``.
+    """
+
+    _GUARD_KEY = "jax_transfer_guard_device_to_host"
+
+    def __init__(self, transfer_guard: str = "disallow",
+                 max_retraces: int = 8) -> None:
+        self._transfer_guard = transfer_guard
+        self.max_retraces = int(max_retraces)
+        self._active = False
+        self._prev_guard: Optional[str] = None
+        #: label -> last observed compile count, for introspection/tests.
+        self.retrace_counts: dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._active
+
+    @property
+    def transfer_guard(self) -> str:
+        """The configured guard level ("disallow", "log", ...) — read by
+        the Looper's per-wave guard so both layers honor one knob."""
+        return self._transfer_guard
+
+    def activate(self) -> None:
+        if self._active:
+            return
+        self._prev_guard = getattr(jax.config, self._GUARD_KEY, None)
+        jax.config.update(self._GUARD_KEY, self._transfer_guard)
+        self._active = True
+
+    def deactivate(self) -> None:
+        if not self._active:
+            return
+        jax.config.update(self._GUARD_KEY, self._prev_guard)
+        self._active = False
+
+    def note_retraces(self, label: str, jitted_fn) -> Optional[int]:
+        """Record the compile count of ``jitted_fn`` under ``label``;
+        raise once it exceeds the budget. No-op (returns None) when
+        strict mode is off or the fn doesn't expose a compile cache."""
+        if not self._active:
+            return None
+        cache_size = getattr(jitted_fn, "_cache_size", None)
+        if not callable(cache_size):  # pragma: no cover - jax internals moved
+            return None
+        count = int(cache_size())
+        self.retrace_counts[label] = count
+        if count > self.max_retraces:
+            raise RuntimeError(
+                f"StrictMode: '{label}' has compiled {count} times "
+                f"(max_retraces={self.max_retraces}). Every new input "
+                "shape/dtype recompiles the step — pad batches to a fixed "
+                "shape (DataLoader wrap padding), pin dtypes, or raise "
+                "Runtime(strict_max_retraces=...) if the shape set is "
+                "genuinely finite."
+            )
+        return count
 
 
 class IdentityRegistry:
@@ -167,6 +253,10 @@ class Runtime:
     device_placement:
         When True, ``Dataset`` moves batches onto the mesh automatically
         (reference ``dataset.py:111-118``).
+    strict:
+        Opt into :class:`StrictMode` (transfer guard + retrace budget).
+        None (default) reads ``ROCKET_TPU_STRICT`` from the environment;
+        tune with ``strict_transfer_guard`` / ``strict_max_retraces``.
     """
 
     #: Name of the batch-sharded mesh axis group. Parallel schemes that shard
@@ -193,6 +283,9 @@ class Runtime:
         device_cache_bytes: int = 1 << 30,
         project_dir: str = ".",
         seq_axis: Optional[str] = None,
+        strict: Optional[bool] = None,
+        strict_transfer_guard: str = "disallow",
+        strict_max_retraces: int = 8,
     ) -> None:
         _enable_compilation_cache()
         _maybe_initialize_distributed()
@@ -252,6 +345,20 @@ class Runtime:
 
         # Tracker backends keyed by name (reference `log_with`/`get_tracker`).
         self.trackers: dict[str, Any] = {}
+
+        # Strict mode (transfer guard + retrace budget, see StrictMode).
+        # Default: off; ROCKET_TPU_STRICT=1 opts a whole run in without
+        # touching code, an explicit strict= argument wins over the env.
+        if strict is None:
+            strict = os.environ.get(
+                "ROCKET_TPU_STRICT", ""
+            ).strip().lower() in ("1", "true", "yes", "on")
+        self.strict = StrictMode(
+            transfer_guard=strict_transfer_guard,
+            max_retraces=strict_max_retraces,
+        )
+        if strict:
+            self.strict.activate()
 
         self._warned_replicated_batch = False
 
@@ -480,9 +587,14 @@ class Runtime:
     # -- teardown ----------------------------------------------------------
 
     def end_training(self) -> None:
-        """Flush/close trackers (reference ``end_training``, ``launcher.py:55``)."""
+        """Flush/close trackers (reference ``end_training``, ``launcher.py:55``)
+        and release strict mode's process-global transfer guard — without
+        this, a later non-strict Runtime in the same process would inherit
+        the 'disallow' guard and raise on its own (legitimate) implicit
+        transfers."""
         for tracker in self.trackers.values():
             close = getattr(tracker, "close", None)
             if close is not None:
                 close()
         self.trackers.clear()
+        self.strict.deactivate()

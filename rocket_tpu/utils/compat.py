@@ -1,0 +1,46 @@
+"""Version-compat shims over moving JAX APIs.
+
+One place owns every "which JAX is installed?" branch so call sites stay
+on the *newest* spelling and old releases are adapted underneath:
+
+* ``shard_map`` moved out of ``jax.experimental`` in jax >= 0.8;
+* its replication-check kwarg was renamed ``check_rep`` -> ``check_vma``
+  (the vma / varying-manual-axes rework). Callers here always say
+  ``check_vma=...``; the shim translates for whichever signature the
+  installed JAX exposes. Policy: docs/migrating.md ("check_vma / check_rep
+  compat").
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Optional
+
+try:  # jax >= 0.8 moved shard_map out of experimental
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+__all__ = ["shard_map"]
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: Optional[bool] = None,
+              **kwargs):
+    """``jax.shard_map`` with the jax >= 0.8 kwarg spelling on any JAX.
+
+    ``check_vma=None`` leaves the installed default in place. Passing a
+    bool forwards it as ``check_vma`` (new JAX) or ``check_rep`` (old
+    JAX); if the installed shard_map has neither knob the flag is dropped.
+    """
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -42,8 +42,10 @@ class TopKAccuracy(Metric):
     def launch(self, attrs: Attributes | None = None) -> None:
         if attrs is None or attrs.batch is None:
             return
-        logits = np.asarray(attrs.batch[self._logits_key])
-        labels = np.asarray(attrs.batch[self._labels_key])
+        # Host path: the Meter already gathered these as numpy — asarray is
+        # a free view, not a device sync.
+        logits = np.asarray(attrs.batch[self._logits_key])  # rocketlint: disable=RKT106
+        labels = np.asarray(attrs.batch[self._labels_key])  # rocketlint: disable=RKT106
         topk = np.argsort(logits, axis=-1)[..., -self._k:]
         self._correct += int((topk == labels[..., None]).any(axis=-1).sum())
         self._total += int(labels.shape[0])
@@ -70,10 +72,15 @@ class TopKAccuracy(Metric):
         self._total = self._total + reduced["total"]
 
     def reset(self, attrs: Attributes | None = None) -> None:
-        # THE once-per-epoch materialization point for the lazy accumulators.
-        total = int(np.asarray(self._total))
+        # THE once-per-epoch materialization point for the lazy
+        # accumulators: one batched explicit device_get (legal under
+        # StrictMode's transfer guard).
+        import jax
+
+        correct, total = jax.device_get((self._correct, self._total))
+        total = int(np.asarray(total))
         if total:
-            self.value = float(np.asarray(self._correct)) / total
+            self.value = float(np.asarray(correct)) / total
             self.publish(attrs, self._tag, self.value)
         self._correct = 0
         self._total = 0
@@ -143,8 +150,10 @@ class Perplexity(Metric):
         if size is None:
             size = tokens.shape[0]
         s, n = self._nll_sum(logits, tokens, size, jnp)
-        self._nll += float(np.asarray(s))
-        self._count += int(np.asarray(n))
+        # Lazy device accumulation (same contract as consume()) — reset()
+        # materializes once per epoch instead of a D2H sync per batch.
+        self._nll = self._nll + s
+        self._count = self._count + n
 
     def device_reduce(self, batch, real_size):
         import jax.numpy as jnp
@@ -159,9 +168,14 @@ class Perplexity(Metric):
         self._count = self._count + reduced["count"]
 
     def reset(self, attrs: Attributes | None = None) -> None:
-        count = int(np.asarray(self._count))
+        # One batched explicit device_get: the once-per-epoch
+        # materialization point, legal under StrictMode's transfer guard.
+        import jax
+
+        nll, count = jax.device_get((self._nll, self._count))
+        count = int(np.asarray(count))
         if count:
-            self.value = float(np.exp(np.asarray(self._nll, np.float64) / count))
+            self.value = float(np.exp(np.float64(np.asarray(nll)) / count))
             self.publish(attrs, self._tag, self.value)
         self._nll = 0.0
         self._count = 0

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
+# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on the
+# first failing stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ruff (style / imports) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check rocket_tpu tests scripts examples bench.py
+else
+    echo "ruff not installed - skipping style lint (config in pyproject.toml)"
+fi
+
+echo "== rocketlint (python -m rocket_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis rocket_tpu/
+
+echo "== tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc

@@ -1,0 +1,10 @@
+"""RKT104 true positive: lifecycle overrides that drop the base call."""
+from rocket_tpu.core.capsule import Capsule
+
+
+class LeakyCapsule(Capsule):
+    def setup(self, attrs=None):
+        self.resource = object()  # BAD: never registers with the runtime
+
+    def destroy(self, attrs=None):
+        self.resource = None  # BAD: never unwinds the checkpoint stack

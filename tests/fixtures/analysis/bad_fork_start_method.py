@@ -1,0 +1,13 @@
+"""RKT107 true positive: forking a (potentially multithreaded) JAX parent."""
+import multiprocessing
+import os
+
+
+def make_pool():
+    ctx = multiprocessing.get_context("fork")  # BAD
+    return ctx
+
+
+def spawn_child():
+    pid = os.fork()  # BAD
+    return pid

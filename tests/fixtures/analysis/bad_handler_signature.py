@@ -1,0 +1,10 @@
+"""RKT105 true positive: handlers dispatch() cannot call as handler(attrs)."""
+from rocket_tpu.core.capsule import Capsule
+
+
+class WrongArity(Capsule):
+    def launch(self):  # BAD: no slot for attrs
+        pass
+
+    def reset(self, attrs, extra):  # BAD: a second REQUIRED param
+        pass

@@ -1,0 +1,11 @@
+"""RKT102 true positive: trace-time side effects inside a jit region."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def noisy_step(x):
+    print("step!")  # BAD: prints once, at trace time
+    noise = np.random.normal(size=())  # BAD: a constant after trace
+    return x + jnp.float32(noise)

@@ -1,0 +1,11 @@
+"""RKT106 true positive: per-iteration D2H sync in a capsule launch."""
+import numpy as np
+
+from rocket_tpu.core.capsule import Capsule
+
+
+class SyncingMetric(Capsule):
+    def launch(self, attrs=None):
+        value = attrs.step_metrics.loss
+        self.total = getattr(self, "total", 0.0) + float(value)  # BAD
+        self.history = np.asarray(value)  # BAD: per-step materialization

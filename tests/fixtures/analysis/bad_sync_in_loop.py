@@ -1,0 +1,11 @@
+"""RKT103 true positive: device sync inside the iteration loop."""
+import jax
+
+
+def drive(step, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step(state, batch)
+        losses.append(jax.device_get(loss))  # BAD: D2H sync per iteration
+        jax.block_until_ready(state)  # BAD: serializes host and device
+    return losses

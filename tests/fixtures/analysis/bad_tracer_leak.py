@@ -1,0 +1,14 @@
+"""RKT101 true positive: tracer forced to host inside a jit region."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def train_step(state, batch):
+    loss = jnp.mean(batch["x"] ** 2)
+    scale = float(loss)  # BAD: concretizes the tracer
+    host = np.asarray(loss)  # BAD: materializes the tracer on host
+    return state, loss * scale + host.sum()
+
+
+step = jax.jit(train_step, donate_argnums=(0,))

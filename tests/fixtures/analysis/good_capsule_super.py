@@ -1,0 +1,19 @@
+"""RKT104 clean negative: overrides chain to the base hook."""
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+
+
+class TidyCapsule(Capsule):
+    def setup(self, attrs=None):
+        super().setup(attrs)
+        self.resource = object()
+
+    def destroy(self, attrs=None):
+        self.resource = None
+        super().destroy(attrs)
+
+
+class ExplicitBase(Dispatcher):
+    def setup(self, attrs=None):
+        # The explicit-base spelling (Launcher's idiom) also counts.
+        Dispatcher.setup(self, attrs)

@@ -1,0 +1,9 @@
+"""RKT107 clean negative: fork-free process creation."""
+import multiprocessing
+
+
+def make_pool(start_method=None):
+    if start_method is None:
+        methods = multiprocessing.get_all_start_methods()
+        start_method = "forkserver" if "forkserver" in methods else "spawn"
+    return multiprocessing.get_context(start_method)

@@ -1,0 +1,17 @@
+"""RKT105 clean negative: the (self, attrs) contract, plus non-handler
+methods with free signatures."""
+from rocket_tpu.core.capsule import Capsule
+
+
+class WellFormed(Capsule):
+    def launch(self, attrs=None):
+        pass
+
+    def reset(self, attrs=None, verbose=False):  # extra DEFAULTED param ok
+        pass
+
+    def set(self, *args):  # attrs lands in *args: callable
+        pass
+
+    def helper(self, a, b, *args, **kwargs):  # not a lifecycle hook
+        return a, b, args, kwargs

@@ -1,0 +1,14 @@
+"""RKT102 clean negative: per-step effects via jax primitives."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def quiet_step(x, key):
+    noise = jax.random.normal(key, ())  # keyed RNG, fresh per step
+    return x + noise
+
+
+def log_outside(x):
+    print("host-side logging outside the traced region is fine:", x)
+    return jnp.asarray(x)

@@ -1,0 +1,15 @@
+"""RKT106 clean negative: lazy device accumulation; materialize in
+reset(), the epoch boundary."""
+import numpy as np
+
+from rocket_tpu.core.capsule import Capsule
+
+
+class LazyMetric(Capsule):
+    def launch(self, attrs=None):
+        value = attrs.step_metrics.loss
+        self.total = getattr(self, "total", 0.0) + value  # lazy jnp add
+
+    def reset(self, attrs=None):
+        self.value = float(np.asarray(self.total))  # once per epoch
+        self.total = 0.0

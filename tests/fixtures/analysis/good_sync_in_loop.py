@@ -1,0 +1,10 @@
+"""RKT103 clean negative: the loop stays async; one batched read after."""
+import jax
+
+
+def drive(step, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step(state, batch)
+        losses.append(loss)  # lazy device scalar, no sync
+    return jax.device_get(losses)  # one batched transfer past the loop

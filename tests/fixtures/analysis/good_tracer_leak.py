@@ -1,0 +1,18 @@
+"""RKT101 clean negative: symbolic math in the jit region, host math
+outside it."""
+import jax
+import jax.numpy as jnp
+
+
+def train_step(state, batch):
+    loss = jnp.mean(batch["x"] ** 2)
+    scale = jnp.sqrt(loss)  # stays symbolic
+    return state, loss * scale
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+
+
+def report(metrics):
+    # Host conversion OUTSIDE the traced region is fine.
+    return float(metrics)

@@ -1,0 +1,23 @@
+"""Suppression fixtures: the same hazards as the bad files, annotated.
+
+# rocketlint: disable-file=RKT103
+"""
+import jax
+
+
+def drive(step, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step(state, batch)
+        # File-wide directive above silences RKT103 for both sync calls.
+        losses.append(jax.device_get(loss))
+        jax.block_until_ready(state)
+    return losses
+
+
+def train_step(state, batch):
+    scale = float(batch["scale"])  # rocketlint: disable=RKT101 — static per epoch
+    return state, scale
+
+
+step = jax.jit(train_step, donate_argnums=(0,))

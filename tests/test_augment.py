@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import rocket_tpu as rt
 from rocket_tpu import optim

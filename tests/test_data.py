@@ -231,18 +231,23 @@ def test_num_workers_matches_serial_order_and_content():
         workers.close()
 
 
+class PerSample:
+    """Module-level so it pickles into spawn/forkserver workers."""
+
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        import numpy as np
+
+        return {"x": np.full((3,), i, np.float32)}
+
+
 def test_num_workers_per_sample_dataset_and_errors():
     import numpy as np
     import pytest
 
     from rocket_tpu.data.loader import DataLoader
-
-    class PerSample:
-        def __len__(self):
-            return 10
-
-        def __getitem__(self, i):
-            return {"x": np.full((3,), i, np.float32)}
 
     loader = DataLoader(PerSample(), batch_size=4, num_workers=2)
     try:
@@ -257,6 +262,43 @@ def test_num_workers_per_sample_dataset_and_errors():
 
     with pytest.raises(ValueError, match="map-style"):
         DataLoader(iter(range(5)), batch_size=2, num_workers=2)
+
+
+def test_default_worker_start_method_avoids_fork_warning():
+    """The default start method must not os.fork() the (multithreaded) JAX
+    parent: JAX's 'os.fork() is incompatible with multithreaded code'
+    RuntimeWarning stays silent, and 'fork' remains an explicit opt-in."""
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from rocket_tpu.data.datasets import ArrayDataset
+    from rocket_tpu.data.loader import DataLoader
+    from rocket_tpu.data.workers import default_start_method
+
+    jax.devices()  # ensure the backend (and its threads) are up
+
+    assert default_start_method() in ("forkserver", "spawn")
+
+    data = ArrayDataset(
+        np.arange(64, dtype=np.float32).reshape(16, 4),
+        np.zeros(16, np.int32),
+    )
+    loader = DataLoader(data, batch_size=4, num_workers=2)
+    with warnings.catch_warnings(record=True) as record:
+        warnings.simplefilter("always")
+        try:
+            batches = list(loader)
+            pool = loader._worker_pool
+        finally:
+            loader.close()
+    assert len(batches) == 4
+    assert pool.start_method == default_start_method()
+    fork_warnings = [
+        w for w in record if "os.fork" in str(w.message)
+    ]
+    assert not fork_warnings, [str(w.message) for w in fork_warnings]
 
 
 def test_device_cache_dtype_and_store_keying():

@@ -1,7 +1,7 @@
 import numpy as np
 import pytest
 
-from rocket_tpu import Attributes, Dataset, Launcher, Looper
+from rocket_tpu import Dataset, Launcher, Looper
 from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.utils.probe import Probe
 

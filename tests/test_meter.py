@@ -7,7 +7,6 @@ import jax
 import numpy as np
 import pytest
 
-import rocket_tpu as rt
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.meter import Meter, Metric
 

@@ -7,7 +7,7 @@ import pytest
 
 import rocket_tpu as rt
 from rocket_tpu import optim
-from rocket_tpu.models.vit import ViT, vit_tiny
+from rocket_tpu.models.vit import ViT
 
 
 def test_vit_shapes_and_param_structure():
