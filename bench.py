@@ -593,6 +593,50 @@ def _pick_headline(results):
         or next(iter(results.values()))
 
 
+#: Budget-file directory the static SPMD auditor maintains
+#: (``python -m rocket_tpu.analysis shard --update-budgets``).
+BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets",
+)
+
+
+def shard_audit_summary(budgets_dir=BUDGETS_DIR):
+    """The audited per-device HBM estimate and per-step collective-bytes
+    totals for the repo's canonical sharded configs, read from the
+    checked-in budget records the SPMD self-gate verifies every CI run
+    (the audit itself runs on fake CPU meshes — re-running it here would
+    duplicate the gate, not the measurement). None when no budgets are
+    committed; never raises — BENCH emission must survive a missing or
+    corrupt record."""
+    try:
+        from rocket_tpu.analysis.budgets import GATED_KEYS, load_budget
+        names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
+            if f.endswith(".json")
+        )
+        targets = {}
+        for name in names:
+            record = load_budget(budgets_dir, name)
+            if record is None:
+                continue
+            targets[name] = {key: record.get(key) for key in GATED_KEYS}
+        if not targets:
+            return None
+        return {
+            "targets": targets,
+            "hbm_per_device_bytes": max(
+                t["hbm_per_device_bytes"] or 0 for t in targets.values()
+            ),
+            "collective_bytes_per_step": max(
+                t["collective_bytes_per_step"] or 0 for t in targets.values()
+            ),
+            "source": "tests/fixtures/budgets",
+        }
+    except Exception:  # noqa: BLE001 — emission must never die on this
+        return None
+
+
 def write_detail(results, path=DETAIL_PATH):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
@@ -626,6 +670,11 @@ def write_detail(results, path=DETAIL_PATH):
         "value_policy": VALUE_POLICY,
         "configs": configs,
     }
+    audit = shard_audit_summary(BUDGETS_DIR)
+    if audit is not None:
+        # Statically-audited SPMD cost alongside the measured throughput:
+        # per-device HBM estimate + per-step collective bytes per target.
+        detail["shard_audit"] = audit
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

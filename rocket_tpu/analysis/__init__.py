@@ -11,17 +11,41 @@ rule catalog):
 * :mod:`~rocket_tpu.analysis.trace_audit` — jaxpr audit of a concrete
   step function (donation, host callbacks, weak types, wide dtypes,
   retrace budget) via abstract evaluation.
+* :mod:`~rocket_tpu.analysis.shard_audit` — static SPMD audit: the real
+  train/eval step AOT-compiled on fake CPU meshes under the repo's
+  sharding rule sets; dead rules, rank/divisibility mismatches,
+  silently replicated params, excess collectives in the *compiled*
+  module, and per-device HBM / collective-bytes budgets
+  (:mod:`~rocket_tpu.analysis.budgets`). CLI:
+  ``python -m rocket_tpu.analysis shard``.
 * strict mode — ``Runtime(strict=True)`` (``runtime/context.py``): a
   ``jax.transfer_guard`` plus a retrace counter enforcing the same
-  contracts on a live run.
+  contracts on a live run; the SPMD auditor's collective count is
+  surfaced as a tracker scalar through the same channel.
 
 Suppress a justified finding inline with ``# rocketlint: disable=RKT1xx``
-(see :mod:`~rocket_tpu.analysis.findings`).
+(see :mod:`~rocket_tpu.analysis.findings`); ``audit_step`` honors the
+same directives written on the step function's own lines.
 """
 
-from rocket_tpu.analysis.findings import Finding, parse_suppressions
+from rocket_tpu.analysis.findings import (
+    Finding,
+    emit_findings,
+    parse_suppressions,
+)
 from rocket_tpu.analysis.rocketlint import lint_file, lint_paths, lint_source
-from rocket_tpu.analysis.rules import AST_RULES, AUDIT_RULES, all_rules
+from rocket_tpu.analysis.rules import (
+    AST_RULES,
+    AUDIT_RULES,
+    SPMD_RULES,
+    all_rules,
+)
+from rocket_tpu.analysis.shard_audit import (
+    ShardAuditReport,
+    audit_sharding,
+    estimate_hbm,
+    parse_collectives,
+)
 from rocket_tpu.analysis.trace_audit import (
     audit_retraces,
     audit_step,
@@ -31,13 +55,19 @@ from rocket_tpu.analysis.trace_audit import (
 __all__ = [
     "Finding",
     "parse_suppressions",
+    "emit_findings",
     "lint_source",
     "lint_file",
     "lint_paths",
     "audit_step",
     "audit_retraces",
     "trace_signature",
+    "audit_sharding",
+    "ShardAuditReport",
+    "estimate_hbm",
+    "parse_collectives",
     "AST_RULES",
     "AUDIT_RULES",
+    "SPMD_RULES",
     "all_rules",
 ]

@@ -1,28 +1,127 @@
-"""CLI: ``python -m rocket_tpu.analysis <paths...>``.
+"""CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``... shard``.
 
-Lints the given files/directories with every rocketlint rule and exits
-non-zero when unsuppressed findings remain — the shape CI wants
-(``scripts/check.sh`` wires it together with ruff and the tier-1 tests).
+Two entry points, one process contract (exit 0 = clean, 1 = findings,
+2 = usage error) and one ``--format json`` output shape
+(:func:`~rocket_tpu.analysis.findings.emit_findings`):
 
-The jaxpr-audit rules (RKT2xx) need a concrete step function and example
-inputs, so they run from code/tests via
-:func:`rocket_tpu.analysis.audit_step`, not from this path-based CLI;
-``--list-rules`` documents both families.
+* the default (path) form lints files/directories with every rocketlint
+  rule — the shape CI wants (``scripts/check.sh`` wires it together
+  with ruff, the SPMD self-gate and the tier-1 tests);
+* ``shard`` audits the repo's canonical (model, rule-set, mesh)
+  pairings with the static SPMD auditor
+  (:mod:`rocket_tpu.analysis.shard_audit`): dead sharding rules,
+  rank/divisibility mismatches, silently replicated params, excess
+  collectives in the *compiled* module, and HBM/collective-bytes
+  budgets (``--budgets`` dir, ``--update-budgets`` to re-baseline).
+
+The jaxpr-audit rules (RKT2xx) need a concrete step function and
+example inputs, so they run from code/tests via
+:func:`rocket_tpu.analysis.audit_step`, not from this CLI;
+``--list-rules`` documents all three families.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
+from rocket_tpu.analysis.findings import emit_findings
 from rocket_tpu.analysis.rocketlint import lint_paths
 from rocket_tpu.analysis.rules import all_rules
 
 
+def _shard_main(argv) -> int:
+    # The auditor compiles under fake meshes: default to the CPU backend
+    # with 8 virtual devices unless the caller chose a platform. XLA_FLAGS
+    # is read at client creation, so the env is early enough — but jax was
+    # already imported by the package __init__ and froze JAX_PLATFORMS
+    # into its config, so the platform default must go through
+    # jax.config.update (tests/conftest.py does the same).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if getattr(jax.config, "jax_platforms", None) in (None, ""):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from rocket_tpu.analysis import budgets as budgets_mod
+    from rocket_tpu.analysis.shard_audit import BUILTIN_TARGETS, run_target
+
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.analysis shard",
+        description="static SPMD sharding / collective-traffic / "
+                    "HBM-budget audit on fake CPU meshes",
+    )
+    parser.add_argument(
+        "--target", action="append", choices=sorted(BUILTIN_TARGETS),
+        help="audit only these targets (default: every non-demo target)",
+    )
+    parser.add_argument("--list-targets", action="store_true",
+                        help="print the target catalog and exit")
+    parser.add_argument(
+        "--budgets", default=None, metavar="DIR",
+        help="budget-file directory (e.g. tests/fixtures/budgets): diff "
+        "each target against its committed record and fail on "
+        f">{budgets_mod.TOLERANCE * 100:.0f}%% growth",
+    )
+    parser.add_argument(
+        "--update-budgets", action="store_true",
+        help="rewrite the budget files from this run instead of diffing",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=budgets_mod.TOLERANCE,
+        help="allowed relative growth before a budget diff fails",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+
+    if args.list_targets:
+        for name, target in sorted(BUILTIN_TARGETS.items()):
+            mesh = "x".join(str(s) for s in target.mesh_shape.values())
+            tag = "  [demo]" if target.demo else ""
+            print(f"{name:14s} mesh={mesh} "
+                  f"({dict(target.mesh_shape)}){tag}")
+        return 0
+    if args.update_budgets and not args.budgets:
+        parser.error("--update-budgets requires --budgets DIR")
+
+    names = args.target or [
+        name for name, target in BUILTIN_TARGETS.items() if not target.demo
+    ]
+    findings = []
+    for name in names:
+        target = BUILTIN_TARGETS[name]
+        report = run_target(target)
+        findings.extend(report.findings)
+        if target.demo or not args.budgets:
+            continue
+        if args.update_budgets:
+            budgets_mod.write_budget(args.budgets, name, report.record)
+        else:
+            findings.extend(budgets_mod.diff_budget(
+                name, budgets_mod.load_budget(args.budgets, name),
+                report.record, tolerance=args.tolerance,
+            ))
+
+    emit_findings(findings, fmt=args.format)
+    return 1 if findings else 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "shard":
+        return _shard_main(argv[1:])
+
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.analysis",
-        description="rocketlint: static analysis for rocket_tpu fast paths",
+        description="rocketlint: static analysis for rocket_tpu fast "
+                    "paths (see also the `shard` subcommand)",
     )
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")
@@ -40,7 +139,7 @@ def main(argv=None) -> int:
             print(f"{rule_id}  {slug:22s} {contract}")
         return 0
     if not args.paths:
-        parser.error("no paths given (or use --list-rules)")
+        parser.error("no paths given (or use --list-rules / shard)")
 
     select = (
         [r.strip() for r in args.select.split(",") if r.strip()]
@@ -52,15 +151,7 @@ def main(argv=None) -> int:
     except FileNotFoundError as exc:
         parser.error(str(exc))
 
-    if args.format == "json":
-        import json
-
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
-    else:
-        for finding in findings:
-            print(finding.render())
-        if findings:
-            print(f"\n{len(findings)} finding(s).", file=sys.stderr)
+    emit_findings(findings, fmt=args.format)
     return 1 if findings else 0
 
 

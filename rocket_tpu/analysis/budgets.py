@@ -1,0 +1,127 @@
+"""Checked-in SPMD cost budgets and the regression gate (RKT306).
+
+A budget file is one JSON record per audit target
+(``tests/fixtures/budgets/<target>.json``) holding the numbers the
+static auditor estimated for the repo's own train/eval steps:
+
+* ``collective_bytes_per_step`` — estimated bytes moved per device per
+  compiled step, summed over every collective op GSPMD inserted;
+* ``hbm_per_device_bytes`` — per-device footprint estimate (params +
+  optimizer state + activation temps);
+* ``collective_counts`` — per-kind op counts, for the diff message.
+
+``python -m rocket_tpu.analysis shard --update-budgets`` rewrites them;
+the default diff mode fails CI when a gated metric grows more than
+``TOLERANCE`` (10%) over the committed record — a sharding-rule typo
+that replicates a weight matrix shows up here as a collective-bytes or
+HBM jump long before anyone runs on hardware. Shrinking is never an
+error (improvements re-baseline via ``--update-budgets``).
+
+This module's own code is plain-JSON bookkeeping (``bench.py`` reuses
+it to stamp the audited numbers into BENCH_DETAIL.json) — note that
+importing it still executes ``rocket_tpu.analysis.__init__`` and so
+pulls in jax; bench already pays that import for the benchmarks
+themselves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping, Optional
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "TOLERANCE",
+    "GATED_KEYS",
+    "budget_path",
+    "load_budget",
+    "write_budget",
+    "diff_budget",
+]
+
+#: Allowed relative growth over the committed budget before RKT306 fires.
+TOLERANCE = 0.10
+
+#: Record keys the regression gate compares (monotone cost metrics only —
+#: counts are context, not gates).
+GATED_KEYS = ("collective_bytes_per_step", "hbm_per_device_bytes")
+
+#: Default budgets directory, resolved relative to the repo checkout.
+DEFAULT_DIR = os.path.join("tests", "fixtures", "budgets")
+
+
+def budget_path(budgets_dir: str, target: str) -> str:
+    return os.path.join(budgets_dir, f"{target}.json")
+
+
+def load_budget(budgets_dir: str, target: str) -> Optional[dict]:
+    """The committed record for ``target``, or None when absent/corrupt."""
+    try:
+        with open(budget_path(budgets_dir, target)) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def write_budget(budgets_dir: str, target: str, record: Mapping) -> str:
+    """Write ``record`` for ``target``; returns the path written."""
+    os.makedirs(budgets_dir, exist_ok=True)
+    path = budget_path(budgets_dir, target)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(dict(record), fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def diff_budget(
+    target: str,
+    committed: Optional[Mapping],
+    measured: Mapping,
+    tolerance: float = TOLERANCE,
+) -> list[Finding]:
+    """RKT306 findings for ``measured`` vs the ``committed`` record.
+
+    A missing budget file is itself a finding — a new audit target must
+    land with its baseline (run ``--update-budgets``), or CI would
+    silently gate nothing.
+    """
+    path = f"<spmd:{target}>"
+    if committed is None:
+        return [Finding(
+            "RKT306", path, 0,
+            "budget-regression: no committed budget for this target — "
+            "run `python -m rocket_tpu.analysis shard --update-budgets` "
+            "and commit tests/fixtures/budgets/",
+        )]
+    findings = []
+    for key in GATED_KEYS:
+        old = committed.get(key)
+        new = measured.get(key)
+        if not isinstance(old, (int, float)) or not isinstance(new, (int, float)):
+            continue
+        if old <= 0:
+            # Growth from a zero baseline is infinite — the one case the
+            # gate exists for most; never silently pass it.
+            if new > 0:
+                findings.append(Finding(
+                    "RKT306", path, 0,
+                    f"budget-regression: {key} grew from a zero baseline "
+                    f"to {new:,.0f} bytes — if intended, re-baseline with "
+                    "--update-budgets",
+                ))
+            continue
+        growth = (new - old) / old
+        if growth > tolerance:
+            findings.append(Finding(
+                "RKT306", path, 0,
+                f"budget-regression: {key} grew {growth * 100:.1f}% "
+                f"({old:,.0f} -> {new:,.0f} bytes; tolerance "
+                f"{tolerance * 100:.0f}%) — if intended, re-baseline with "
+                "--update-budgets",
+            ))
+    return findings

@@ -20,10 +20,12 @@ comment is the audit trail for each justified one.
 
 from __future__ import annotations
 
+import json
 import re
-from dataclasses import dataclass, field
+import sys
+from dataclasses import asdict, dataclass, field
 
-__all__ = ["Finding", "Suppressions", "parse_suppressions"]
+__all__ = ["Finding", "Suppressions", "parse_suppressions", "emit_findings"]
 
 
 @dataclass(frozen=True)
@@ -31,12 +33,27 @@ class Finding:
     """One analyzer hit."""
 
     rule: str  # e.g. "RKT101"
-    path: str  # file path, or "<trace:label>" for jaxpr audits
+    path: str  # file path, "<trace:label>" (jaxpr) or "<spmd:label>" (SPMD)
     line: int  # 1-based; 0 when the finding has no source line
     message: str
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def emit_findings(findings, fmt: str = "text") -> None:
+    """The one findings printer both CLIs (`rocketlint` paths and the
+    `shard` subcommand) share, so machine consumers parse one shape:
+    ``--format json`` is a list of ``{rule, path, line, message}`` on
+    stdout. The human count line goes to stderr, keeping stdout
+    machine-parseable in both formats."""
+    if fmt == "json":
+        print(json.dumps([asdict(f) for f in findings], indent=2))
+        return
+    for finding in findings:
+        print(finding.render())
+    if findings:
+        print(f"\n{len(findings)} finding(s).", file=sys.stderr)
 
 
 _DIRECTIVE = re.compile(

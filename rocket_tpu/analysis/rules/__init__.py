@@ -1,11 +1,13 @@
-"""Rule registry for rocketlint (AST) and the trace auditor (jaxpr).
+"""Rule registry: rocketlint (AST), trace auditor (jaxpr), SPMD auditor.
 
 Every rule has a stable id (``RKT1xx`` = AST lint, ``RKT2xx`` = jaxpr
-audit), a short slug, and a one-line contract used by ``--list-rules``
-and docs/analysis.md. AST rules expose ``check(ctx) -> Iterable[Finding]``
-over a :class:`~rocket_tpu.analysis.rocketlint.FileContext`; jaxpr rules
-are applied by :mod:`rocket_tpu.analysis.trace_audit` and are listed here
-for the catalog only.
+audit, ``RKT3xx`` = SPMD audit), a short slug, and a one-line contract
+used by ``--list-rules`` and docs/analysis.md. AST rules expose
+``check(ctx) -> Iterable[Finding]`` over a
+:class:`~rocket_tpu.analysis.rocketlint.FileContext`; jaxpr rules are
+applied by :mod:`rocket_tpu.analysis.trace_audit`; SPMD rules by
+:mod:`rocket_tpu.analysis.shard_audit` (their check functions live in
+:mod:`rocket_tpu.analysis.rules.spmd_rules`).
 """
 
 from __future__ import annotations
@@ -23,8 +25,9 @@ from rocket_tpu.analysis.rules.jit_rules import (
     JitSideEffectRule,
     TracerLeakRule,
 )
+from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
-__all__ = ["AST_RULES", "AUDIT_RULES", "all_rules"]
+__all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -61,6 +64,7 @@ AUDIT_RULES = (
 
 
 def all_rules():
-    """(id, slug, contract) for every rule, AST + audit, in id order."""
+    """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
+    (RKT2xx) and SPMD audit (RKT3xx) — in id order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
-    return tuple(sorted(ast_meta + list(AUDIT_RULES)))
+    return tuple(sorted(ast_meta + list(AUDIT_RULES) + list(SPMD_RULES)))

@@ -1,0 +1,606 @@
+"""shard_audit — static SPMD audit of what GSPMD *actually produced*.
+
+``parallel/sharding.py`` rule sets are matched by glob with no feedback:
+a typo silently replicates a weight matrix onto every device, an
+off-by-one spec reshards an activation every layer, and nothing fails
+until HBM runs out on hardware. This pass closes the loop **before any
+run**, entirely on CPU:
+
+1. the rule-set/param-tree fit is checked statically — dead globs
+   (RKT301), rank mismatches (RKT302), mesh-divisibility (RKT303),
+   large params silently replicated (RKT304);
+2. the real train/eval step is AOT-compiled under a *fake mesh*
+   (``--xla_force_host_platform_device_count`` makes 8 CPU devices, the
+   same trick the test suite uses) with the rule set's shardings on
+   abstract inputs — no FLOPs, no params materialized;
+3. the compiled module's collective ops (all-gather / all-reduce /
+   reduce-scatter / all-to-all / collective-permute — what GSPMD
+   inserted, invisible in the jaxpr) are parsed out of the optimized
+   HLO with their per-device shapes, costed with a ring model, and
+   gated by a per-step allowlist (RKT305);
+4. a per-device HBM footprint is estimated (params + optimizer state
+   via shard-aware shape math, activation temps from
+   ``compiled.memory_analysis()`` where available) and, together with
+   the collective bytes, compared against checked-in budget files
+   (RKT306, see :mod:`rocket_tpu.analysis.budgets`).
+
+CLI: ``python -m rocket_tpu.analysis shard`` audits the repo's own
+canonical (model, rule-set, mesh) pairings — the self-gate CI runs via
+``scripts/check.sh``. Library entry: :func:`audit_sharding` for user
+steps. docs/analysis.md has the workflow.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.rules.spmd_rules import (
+    _leaf_nbytes,
+    check_collectives,
+    check_dead_rules,
+    check_replication,
+    check_specs,
+)
+
+__all__ = [
+    "CollectiveOp",
+    "ShardAuditReport",
+    "parse_collectives",
+    "resolve_specs",
+    "estimate_hbm",
+    "audit_sharding",
+    "BUILTIN_TARGETS",
+    "run_target",
+]
+
+Spec = Optional[Tuple]
+
+#: Collective HLO op kinds the auditor tracks.
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in the compiled (SPMD-partitioned) module."""
+
+    kind: str            # "all-gather", ...
+    dtype: str           # HLO dtype of the (first) result
+    shape: Tuple[int, ...]  # per-device result shape
+    group_size: int      # devices cooperating in one replica group
+    result_bytes: int    # per-device result buffer size
+    bytes_moved: int     # ring-model estimate of bytes on the wire/device
+
+
+def _ring_bytes(kind: str, result_bytes: int, n: int) -> int:
+    """Per-device bytes-moved estimate under a ring algorithm.
+
+    Result shapes in SPMD HLO are per-partition: an all-gather's result
+    is the full gathered buffer, a reduce-scatter's the small shard.
+    The constants are the textbook ring costs — good enough to rank and
+    budget traffic; not a latency model.
+    """
+    if n <= 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * (n - 1) / n * result_bytes)
+    if kind == "all-gather":
+        return int((n - 1) / n * result_bytes)
+    if kind == "reduce-scatter":
+        return int((n - 1) * result_bytes)
+    if kind == "all-to-all":
+        return int((n - 1) / n * result_bytes)
+    return int(result_bytes)  # collective-permute: one hop
+
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<types>\(?[^()]*?\)?)\s*"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?:-start)?\(",
+)
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\]")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def parse_collectives(hlo_text: str) -> list[CollectiveOp]:
+    """Collective ops (with per-device result shapes and replica-group
+    sizes) out of an optimized HLO module's text dump.
+
+    Counts ``-start`` ops once and never their ``-done`` halves; operand
+    mentions (``%all-gather.3``) don't match because operand names carry
+    a ``%`` and no following ``(``.
+    """
+    ops: list[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        match = _COLLECTIVE_RE.search(line)
+        if match is None:
+            continue
+        kind = match.group("kind")
+        group_size = 1
+        grp = _GROUPS_LIST_RE.search(line)
+        if grp is not None:
+            group_size = len(grp.group(1).split(","))
+        else:
+            grp = _GROUPS_IOTA_RE.search(line)
+            if grp is not None:
+                group_size = int(grp.group(2))
+        if kind == "collective-permute" and "source_target_pairs" in line:
+            # Permutes carry source_target_pairs, not replica_groups —
+            # point-to-point, so the "group" is the pair.
+            group_size = 2
+        shapes = []
+        for shape_match in _SHAPE_RE.finditer(match.group("types")):
+            dims = tuple(
+                int(x) for x in shape_match.group("dims").split(",") if x
+            )
+            n = 1
+            for dim in dims:
+                n *= dim
+            shapes.append((
+                shape_match.group("dtype"), dims,
+                n * _DTYPE_BYTES.get(shape_match.group("dtype"), 4),
+            ))
+        if not shapes:
+            continue
+        if "-start(" in line and len(shapes) > 1:
+            # An async start's tuple result is (operand alias, result):
+            # cost only the final element so sync and async forms of the
+            # same op agree (an XLA switch to async must not move the
+            # budget numbers).
+            shapes = shapes[-1:]
+        dtype, shape = shapes[0][0], shapes[0][1]
+        result_bytes = sum(nbytes for _d, _dims, nbytes in shapes)
+        ops.append(CollectiveOp(
+            kind=kind, dtype=dtype, shape=shape, group_size=group_size,
+            result_bytes=result_bytes,
+            bytes_moved=_ring_bytes(kind, result_bytes, group_size),
+        ))
+    return ops
+
+
+# -- rule resolution ---------------------------------------------------------
+
+
+def resolve_specs(
+    rules: Callable[[Tuple[str, ...], Any], Spec],
+    params,
+    label: str = "params",
+) -> tuple[list[Tuple[Tuple[str, ...], Any, Spec]], list[Finding]]:
+    """Apply a rule fn to every leaf of ``params``; returns the resolved
+    ``(path, leaf, spec)`` triples plus any findings raised *by* the rule
+    set itself (a :class:`~rocket_tpu.parallel.sharding.ShardingRuleError`
+    from the build-time validation becomes an RKT302 finding here, so
+    one audit reports every bad rule instead of dying on the first)."""
+    from rocket_tpu.parallel.sharding import ShardingRuleError
+    from rocket_tpu.utils.pytree import key_path_names
+
+    triples: list[Tuple[Tuple[str, ...], Any, Spec]] = []
+    findings: list[Finding] = []
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = key_path_names(key_path)
+        try:
+            spec = rules(path, leaf)
+        except ShardingRuleError as exc:
+            findings.append(Finding(
+                "RKT302", f"<spmd:{label}>", 0,
+                f"spec-rank-mismatch: {exc}",
+            ))
+            spec = None
+        triples.append((path, leaf, spec))
+    return triples, findings
+
+
+def _shard_factor(spec: Spec, mesh_shape: Mapping[str, int]) -> int:
+    """How many ways a spec splits one leaf across the mesh."""
+    if spec is None:
+        return 1
+    factor = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for axis in axes:
+            factor *= int(mesh_shape.get(str(axis), 1))
+    return factor
+
+
+def estimate_hbm(
+    specs: Sequence[Tuple[Tuple[str, ...], Any, Spec]],
+    mesh_shape: Mapping[str, int],
+    optimizer_slots: int = 2,
+    compiled=None,
+) -> dict:
+    """Per-device HBM footprint estimate.
+
+    Params and optimizer state (``optimizer_slots`` param-shaped moment
+    trees, 2 for Adam — laid out like the params, see
+    ``Module._place_state``) are pure shard-aware shape math. Activation
+    temps come from ``compiled.memory_analysis()`` when the backend
+    exposes it (CPU and TPU both do); otherwise the estimate is flagged
+    partial rather than padded with a made-up number.
+    """
+    params_bytes = sum(
+        _leaf_nbytes(leaf) // max(_shard_factor(spec, mesh_shape), 1)
+        for _path, leaf, spec in specs
+    )
+    optimizer_bytes = optimizer_slots * params_bytes
+    activation_bytes = None
+    method = "shape-math"
+    if compiled is not None:
+        try:
+            stats = compiled.memory_analysis()
+        except Exception:  # backend without memory analysis
+            stats = None
+        if stats is not None:
+            temp = getattr(stats, "temp_size_in_bytes", None)
+            if isinstance(temp, int) and temp > 0:
+                activation_bytes = temp
+                method = "memory_analysis"
+    total = params_bytes + optimizer_bytes + (activation_bytes or 0)
+    return {
+        "params_bytes": int(params_bytes),
+        "optimizer_bytes": int(optimizer_bytes),
+        "activation_bytes": activation_bytes,
+        "total_bytes": int(total),
+        "method": method,
+    }
+
+
+# -- the orchestrator --------------------------------------------------------
+
+
+@dataclass
+class ShardAuditReport:
+    """Everything one audit produced: findings plus the cost record the
+    budget gate (and BENCH emission) consumes."""
+
+    label: str
+    findings: list[Finding] = field(default_factory=list)
+    collectives: list[CollectiveOp] = field(default_factory=list)
+    record: dict = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _mesh_from_shape(mesh_shape: Mapping[str, int]) -> jax.sharding.Mesh:
+    sizes = tuple(int(s) for s in mesh_shape.values())
+    need = int(np.prod(sizes)) if sizes else 1
+    devices = jax.devices()
+    if len(devices) < need:
+        raise RuntimeError(
+            f"shard_audit: mesh {dict(mesh_shape)} needs {need} devices, "
+            f"have {len(devices)} — run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{need} (the CLI sets this itself)."
+        )
+    return jax.sharding.Mesh(
+        np.asarray(devices[:need]).reshape(sizes), tuple(mesh_shape.keys())
+    )
+
+
+def _abstract(leaf, sharding) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(
+        tuple(leaf.shape), leaf.dtype, sharding=sharding
+    )
+
+
+def audit_sharding(
+    step_fn: Callable,
+    variables,
+    batch,
+    *,
+    rules: Callable[[Tuple[str, ...], Any], Spec],
+    mesh_shape: Mapping[str, int],
+    mesh: Optional[jax.sharding.Mesh] = None,
+    data_axes: Tuple[str, ...] = ("data",),
+    allow: Optional[Mapping[str, int]] = None,
+    replicated_bytes_limit: int = 1 << 20,
+    optimizer_slots: int = 2,
+    donate_argnums: Sequence[int] = (),
+    label: str = "step",
+) -> ShardAuditReport:
+    """Audit ``step_fn(variables, batch)`` under ``rules`` on a fake mesh.
+
+    ``variables`` / ``batch`` may be concrete arrays or
+    ``ShapeDtypeStruct``s (``jax.eval_shape(model.init, key)`` output is
+    the intended zero-FLOP path). The rules address the ``"params"``
+    subtree of ``variables`` when present (the ``Module`` convention),
+    the whole tree otherwise; batch leaves are sharded over ``data_axes``
+    on their leading dim when divisible, replicated otherwise.
+
+    Returns a :class:`ShardAuditReport`; ``report.record`` is the budget
+    record (:mod:`rocket_tpu.analysis.budgets`) and ``report.findings``
+    the RKT30x hits. Pure abstract evaluation + XLA compilation — no
+    FLOPs run, no params materialize, no TPU required.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = _mesh_from_shape(mesh_shape)
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    params = (
+        variables["params"]
+        if isinstance(variables, dict) and "params" in variables
+        else variables
+    )
+    specs, findings = resolve_specs(rules, params, label=label)
+    patterns = getattr(rules, "patterns", None)
+    if patterns:
+        findings.extend(check_dead_rules(
+            patterns, [path for path, _leaf, _spec in specs], label=label
+        ))
+    findings.extend(check_specs(specs, mesh_shape, label=label))
+    findings.extend(check_replication(
+        specs, mesh_shape, replicated_bytes_limit, label=label
+    ))
+
+    spec_by_path = {path: spec for path, _leaf, spec in specs}
+    placeable = not any(
+        f.rule in ("RKT302", "RKT303") for f in findings
+    )
+
+    def param_sharding(key_path, leaf):
+        from rocket_tpu.utils.pytree import key_path_names
+
+        spec = spec_by_path.get(key_path_names(key_path))
+        if spec is None or not placeable:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(*spec))
+
+    def batch_sharding(leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        axes = tuple(a for a in data_axes if a in mesh_shape)
+        n = int(np.prod([mesh_shape[a] for a in axes])) if axes else 1
+        if shape and n > 1 and shape[0] % n == 0:
+            return NamedSharding(mesh, P(axes))
+        return NamedSharding(mesh, P())
+
+    abs_params = jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: _abstract(leaf, param_sharding(kp, leaf)), params
+    )
+    if isinstance(variables, dict) and "params" in variables:
+        abs_variables = {
+            key: (
+                abs_params
+                if key == "params"
+                else jax.tree.map(
+                    lambda l: _abstract(l, NamedSharding(mesh, P())), value
+                )
+            )
+            for key, value in variables.items()
+        }
+    else:
+        abs_variables = abs_params
+    abs_batch = jax.tree.map(
+        lambda l: _abstract(l, batch_sharding(l)), batch
+    )
+
+    collectives: list[CollectiveOp] = []
+    compiled = None
+    try:
+        with mesh:
+            compiled = (
+                jax.jit(step_fn, donate_argnums=tuple(donate_argnums))
+                .lower(abs_variables, abs_batch)
+                .compile()
+            )
+        collectives = parse_collectives(compiled.as_text())
+        findings.extend(check_collectives(collectives, allow, label=label))
+    except (ValueError, RuntimeError) as exc:
+        # A placement XLA itself rejects (XlaRuntimeError is a
+        # RuntimeError; sharding/mesh complaints are ValueErrors) — a
+        # finding, so one audit reports every bad rule. Anything else
+        # (TypeError from a mismatched step/batch pairing, etc.) is a
+        # caller bug and propagates as-is.
+        findings.append(Finding(
+            "RKT303", f"<spmd:{label}>", 0,
+            f"axis-indivisible: GSPMD compilation failed under this rule "
+            f"set: {str(exc).splitlines()[0][:300]}",
+        ))
+
+    hbm = estimate_hbm(
+        specs, mesh_shape, optimizer_slots=optimizer_slots, compiled=compiled
+    )
+    counts: dict[str, int] = {}
+    for op in collectives:
+        counts[op.kind] = counts.get(op.kind, 0) + 1
+    record = {
+        "mesh": dict(mesh_shape),
+        "collective_counts": counts,
+        "collective_bytes_per_step": int(
+            sum(op.bytes_moved for op in collectives)
+        ),
+        "hbm_per_device_bytes": int(hbm["total_bytes"]),
+        "hbm": hbm,
+    }
+    return ShardAuditReport(
+        label=label, findings=findings, collectives=collectives,
+        record=record,
+    )
+
+
+# -- builtin targets: the repo's own canonical (model, rules, mesh) pairs ----
+
+
+@dataclass(frozen=True)
+class AuditTarget:
+    """One self-gate configuration the CLI audits."""
+
+    name: str
+    mesh_shape: Mapping[str, int]
+    #: () -> (step_fn, variables, batch, rules, donate_argnums)
+    build: Callable[[], tuple]
+    allow: Optional[Mapping[str, int]]
+    optimizer_slots: int = 2
+    replicated_bytes_limit: int = 1 << 20
+    #: Demo targets (seeded-bad rule sets) are excluded from the default
+    #: self-gate sweep and from budget bookkeeping.
+    demo: bool = False
+
+
+def _lm_config():
+    """Tiny swiglu/untied/rope TransformerLM: small enough to compile in
+    ~2 s on CPU, shaped so EVERY glob in ``gpt2_tp_rules`` is live (gelu
+    or tied configs would leave fc_gate / head globs legitimately dead —
+    scope the audit's rule set to the model it places)."""
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=256, max_seq_len=64, dim=128, num_layers=2,
+        num_heads=8, pos_embedding="rope", norm="rmsnorm", mlp="swiglu",
+        tied_embeddings=False, dropout=0.0,
+    )
+
+
+def _lm_parts(rules, *, train: bool = True, batch_size: int = 16):
+    from rocket_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(_lm_config())
+    variables = jax.eval_shape(model.init, jax.random.key(0))
+    batch = {
+        "tokens": jax.ShapeDtypeStruct(
+            (batch_size, model.config.max_seq_len), jnp.int32
+        )
+    }
+
+    if not train:
+        def eval_step(variables, batch):
+            out, _state = model.apply(variables, dict(batch), mode="eval")
+            return out["logits"]
+
+        return eval_step, variables, batch, rules, ()
+
+    import optax
+
+    def loss_fn(variables, batch):
+        out, _state = model.apply(variables, dict(batch), mode="train")
+        logits = out["logits"][:, :-1].astype(jnp.float32)
+        targets = out["tokens"][:, 1:]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    def train_step(variables, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(variables, batch)
+        params = jax.tree.map(
+            lambda p, g: (p - 1e-3 * g).astype(p.dtype),
+            variables["params"], grads["params"],
+        )
+        return {"params": params, "state": variables["state"]}, loss
+
+    return train_step, variables, batch, rules, (0,)
+
+
+def _tp_parts():
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+    return _lm_parts(gpt2_tp_rules(axis="model"))
+
+
+def _tp_eval_parts():
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+    return _lm_parts(gpt2_tp_rules(axis="model"), train=False)
+
+
+def _fsdp_parts():
+    from rocket_tpu.parallel.sharding import fsdp_rules
+
+    return _lm_parts(fsdp_rules(axis="data", min_size=4096))
+
+
+def _badrules_parts():
+    """Seeded-bad rule set for the true-positive fixture tests: a dead
+    glob (RKT301), large params left replicated (RKT304), and a
+    zero-tolerance allowlist any compiled step exceeds (RKT305)."""
+    from rocket_tpu.parallel.sharding import make_rules
+
+    return _lm_parts(make_rules([
+        # Typo'd glob: matches nothing -> RKT301, and the qkv kernels it
+        # meant to shard stay replicated -> RKT304 (with the tiny limit
+        # on the target below).
+        ("*/attn/qkv/w_typo", (None, "model")),
+        # Row-parallel MLP-in with nothing else sharded coherently:
+        # GSPMD must insert reshards -> collectives for RKT305's empty
+        # allowlist to flag.
+        ("*/mlp/fc_in/w", ("model", None)),
+    ]))
+
+
+#: name -> target. Ordered: the default sweep runs the non-demo entries.
+#: Allowlists are measured counts with headroom (a new XLA may legally
+#: shift a few ops; a rule-set regression blows straight through).
+BUILTIN_TARGETS: dict[str, AuditTarget] = {
+    target.name: target
+    for target in (
+        AuditTarget(
+            name="tp_2x4",
+            mesh_shape={"data": 2, "model": 4},
+            build=_tp_parts,
+            allow={"all-gather": 12, "reduce-scatter": 8,
+                   "all-to-all": 0, "collective-permute": 24},
+        ),
+        AuditTarget(
+            name="tp_1x8",
+            mesh_shape={"data": 1, "model": 8},
+            build=_tp_parts,
+            allow={"all-gather": 12, "reduce-scatter": 8,
+                   "all-to-all": 0, "collective-permute": 48},
+        ),
+        AuditTarget(
+            name="fsdp_1x8",
+            mesh_shape={"data": 8},
+            build=_fsdp_parts,
+            allow={"all-gather": 24, "reduce-scatter": 16,
+                   "all-to-all": 0, "collective-permute": 8},
+        ),
+        AuditTarget(
+            name="tp_2x4_eval",
+            mesh_shape={"data": 2, "model": 4},
+            build=_tp_eval_parts,
+            optimizer_slots=0,
+            allow={"all-gather": 8, "reduce-scatter": 8,
+                   "all-to-all": 0, "collective-permute": 24},
+        ),
+        AuditTarget(
+            name="badrules",
+            mesh_shape={"data": 2, "model": 4},
+            build=_badrules_parts,
+            allow={"all-gather": 0, "all-reduce": 0, "reduce-scatter": 0,
+                   "all-to-all": 0, "collective-permute": 0},
+            replicated_bytes_limit=1 << 16,
+            demo=True,
+        ),
+    )
+}
+
+
+def run_target(target: AuditTarget) -> ShardAuditReport:
+    step_fn, variables, batch, rules, donate = target.build()
+    return audit_sharding(
+        step_fn, variables, batch,
+        rules=rules, mesh_shape=target.mesh_shape,
+        allow=target.allow,
+        replicated_bytes_limit=target.replicated_bytes_limit,
+        optimizer_slots=target.optimizer_slots,
+        donate_argnums=donate, label=target.name,
+    )

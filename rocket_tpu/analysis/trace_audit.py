@@ -26,24 +26,58 @@ XLA compilation; shape-polymorphic callers (unpadded trailing batches,
 growing decode lengths) blow the budget and spend the run recompiling.
 
 All checks return :class:`~rocket_tpu.analysis.findings.Finding` lists —
-empty means clean. Runtime enforcement of the same contracts (transfer
-guard + retrace counter) lives in ``runtime/context.py`` strict mode.
+empty means clean. Suppressions have rocketlint parity: a
+``# rocketlint: disable=RKT2xx`` comment anywhere in the audited step
+function's own source suppresses that rule for the audit (jaxpr findings
+carry no source line, so a line-scoped directive inside the function is
+read as scoping to the function). Runtime enforcement of the same
+contracts (transfer guard + retrace counter) lives in
+``runtime/context.py`` strict mode.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Sequence
+import inspect
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax
 import numpy as np
 
-from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.findings import Finding, parse_suppressions
 
 __all__ = ["audit_step", "audit_retraces", "trace_signature"]
 
 
 def _trace_path(label: str) -> str:
     return f"<trace:{label}>"
+
+
+def _fn_suppressed_rules(fn: Callable) -> set:
+    """Rule ids disabled by ``# rocketlint: disable=...`` directives in
+    the step function's own source (rocketlint-parity for the jaxpr
+    audit). Jaxpr findings have no line numbers, so a directive anywhere
+    in the function body applies to the whole audit of that function —
+    which is exactly why only EXPLICIT jaxpr-family ids (``RKT2xx``)
+    count here: a line-scoped ``disable=all`` or an AST-rule id placed
+    to silence rocketlint must not blank the entire jaxpr audit.
+    Functions without retrievable source (C callables, REPL lambdas)
+    suppress nothing."""
+    try:
+        source = inspect.getsource(inspect.unwrap(fn))
+    except (OSError, TypeError):
+        return set()
+    sup = parse_suppressions(source)
+    rules = set(sup.file_wide)
+    for line_rules in sup.by_line.values():
+        rules |= set(line_rules)
+    return {r for r in rules if r.startswith("RKT2")}
+
+
+def _filter_suppressed(findings: list[Finding],
+                       suppressed: Optional[set]) -> list[Finding]:
+    if not suppressed:
+        return findings
+    return [f for f in findings if f.rule not in suppressed]
 
 
 def _aval_key(aval) -> tuple:
@@ -102,8 +136,11 @@ def audit_step(fn: Callable, *example_args,
                static_argnums: Sequence[int] = (),
                **example_kwargs) -> list[Finding]:
     """Abstract-eval ``fn(*example_args, **example_kwargs)`` and audit the
-    resulting jaxpr. Returns the (unsuppressable — fix or don't audit)
-    findings; empty list means the step is clean."""
+    resulting jaxpr. Returns the findings; empty list means the step is
+    clean. A ``# rocketlint: disable=RKT2xx`` comment inside ``fn``'s own
+    source suppresses that rule for this audit (same syntax and audit
+    trail as the AST linter)."""
+    suppressed = _fn_suppressed_rules(fn)
     path = _trace_path(label)
     findings = list(_donated_leaf_ids(example_args, donate_argnums, label))
 
@@ -188,7 +225,7 @@ def audit_step(fn: Callable, *example_args,
                 "weak_type=True (a Python scalar in the step signature); "
                 "pass jnp.asarray(x, dtype) so the signature is stable",
             ))
-    return findings
+    return _filter_suppressed(findings, suppressed)
 
 
 def trace_signature(tree) -> tuple:

@@ -649,16 +649,19 @@ class Module(Dispatcher):
                 # Retrace budget: a host-side cache-size read (no device
                 # op); surfaced through the Tracker so a creeping recompile
                 # shows up on the dashboard before it eats the run.
-                retraces = strict.note_retraces(
-                    f"train_step[{type(self._model).__name__}]",
-                    self._train_step,
-                )
-                if (
-                    retraces is not None  # None: no compile-cache probe
-                    and attrs.tracker is not None
-                    and attrs.sync_gradients
-                ):
-                    attrs.tracker.scalars["retraces"] = retraces
+                step_label = f"train_step[{type(self._model).__name__}]"
+                retraces = strict.note_retraces(step_label, self._train_step)
+                if attrs.tracker is not None and attrs.sync_gradients:
+                    if retraces is not None:  # None: no compile-cache probe
+                        attrs.tracker.scalars["retraces"] = retraces
+                    # The static SPMD audit's per-step collective count
+                    # (strict.note_collectives, fed by
+                    # analysis.shard_audit) rides the same channel:
+                    # declared communication cost next to the live run
+                    # it gates.
+                    audited = strict.collective_counts.get(step_label)
+                    if audited is not None:
+                        attrs.tracker.scalars["audited_collectives"] = audited
             if outputs is not None:
                 attrs.batch = _strip_marker(_merge_batch(outputs, static))
         else:

@@ -14,6 +14,7 @@ import fnmatch
 from typing import Callable, Optional, Sequence, Tuple
 
 __all__ = [
+    "ShardingRuleError",
     "make_rules",
     "gpt2_tp_rules",
     "fsdp_rules",
@@ -27,6 +28,31 @@ Spec = Optional[Tuple]
 RuleFn = Callable[[Tuple[str, ...], object], Spec]
 
 
+class ShardingRuleError(ValueError):
+    """A sharding rule matched a param it cannot legally describe.
+
+    Raised at *build* time (when the rule set is applied to the param
+    tree), carrying the matched glob — previously an over-long spec
+    surfaced only later as an opaque XLA/NamedSharding rank error, or a
+    typo silently replicated the matrix onto every device.
+    """
+
+    def __init__(self, pattern: str, path: Tuple[str, ...], spec: Tuple,
+                 shape: Tuple[int, ...]) -> None:
+        self.pattern = pattern
+        self.path = tuple(path)
+        self.spec = tuple(spec)
+        self.shape = tuple(shape)
+        super().__init__(
+            f"sharding rule {pattern!r} matched param "
+            f"{'/'.join(self.path)} with shape {self.shape} but its spec "
+            f"{self.spec} names {len(self.spec)} dims — a PartitionSpec "
+            "cannot be longer than the param rank (is the rule written "
+            "for the scan-over-layers 'blocks_stacked' layout, or is the "
+            "glob matching the wrong leaf?)"
+        )
+
+
 def make_rules(
     rules: Sequence[Tuple[str, Spec]],
     stacked_prefixes: Tuple[str, ...] = ("blocks_stacked",),
@@ -38,6 +64,13 @@ def make_rules(
     ``stacked_prefixes`` subtree (the scan-over-layers layout, which adds a
     leading layer dim) get the spec left-padded with None — elsewhere a
     short spec keeps JAX's usual meaning (missing TRAILING dims replicated).
+    A spec *longer* than the matched leaf's rank raises
+    :class:`ShardingRuleError` at build time (it used to surface later as
+    an opaque NamedSharding rank error, or not at all).
+
+    The returned fn exposes the rule table as ``rule_fn.patterns`` so the
+    static auditor (``rocket_tpu.analysis.shard_audit``) can detect dead
+    globs that match no param path.
     """
 
     def rule_fn(path: Tuple[str, ...], leaf) -> Spec:
@@ -53,9 +86,17 @@ def make_rules(
                     and path[0] in stacked_prefixes
                 ):
                     spec = (None,) * (len(shape) - len(spec)) + tuple(spec)
+                if (
+                    spec is not None
+                    and shape is not None
+                    and len(spec) > len(shape)
+                ):
+                    raise ShardingRuleError(pattern, path, spec, shape)
                 return spec
         return None
 
+    #: Exposed for the SPMD auditor's dead-rule check (RKT301).
+    rule_fn.patterns = tuple((pattern, spec) for pattern, spec in rules)
     return rule_fn
 
 

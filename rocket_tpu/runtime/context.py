@@ -57,6 +57,14 @@ class StrictMode:
       run in XLA. The count is surfaced through the Tracker as a
       ``retraces`` scalar (see ``core/module.py``).
 
+    Plus one audited fact carried along the same channel: the static
+    SPMD auditor (``rocket_tpu.analysis.shard_audit``) can
+    :meth:`note_collectives` its per-step collective-op count for a
+    step label, and the Module publishes it as an
+    ``audited_collectives`` tracker scalar next to ``retraces`` — the
+    dashboard shows the declared communication cost alongside the
+    live run it gates.
+
     Enable via ``Runtime(strict=True)`` or ``ROCKET_TPU_STRICT=1``.
     """
 
@@ -70,6 +78,8 @@ class StrictMode:
         self._prev_guard: Optional[str] = None
         #: label -> last observed compile count, for introspection/tests.
         self.retrace_counts: dict[str, int] = {}
+        #: label -> audited per-step collective-op count (note_collectives).
+        self.collective_counts: dict[str, int] = {}
 
     @property
     def enabled(self) -> bool:
@@ -114,6 +124,17 @@ class StrictMode:
                 "Runtime(strict_max_retraces=...) if the shape set is "
                 "genuinely finite."
             )
+        return count
+
+    def note_collectives(self, label: str, count: int) -> int:
+        """Record a statically-audited per-step collective-op count for
+        ``label`` (from ``rocket_tpu.analysis.shard_audit``; label
+        convention ``train_step[<ModelClass>]`` matches the Module's
+        retrace label). Recorded regardless of :attr:`enabled` — the
+        audit runs pre-launch — but only surfaced to the Tracker on
+        strict runs (``core/module.py``)."""
+        count = int(count)
+        self.collective_counts[label] = count
         return count
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
-# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on the
-# first failing stage.
+# SPMD shard audit (self-gate + budget diff) + the tier-1 test suite
+# (command from ROADMAP.md). Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,6 +14,12 @@ fi
 
 echo "== rocketlint (python -m rocket_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis rocket_tpu/
+
+echo "== shard audit (SPMD self-gate + budgets) =="
+# Fake 1x8 / 2x4 CPU meshes; fails on sharding-rule findings or a >10%
+# collective-bytes / HBM regression over tests/fixtures/budgets/.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis shard \
+    --budgets tests/fixtures/budgets
 
 echo "== tier-1 tests =="
 set -o pipefail

@@ -165,6 +165,33 @@ def test_audit_wide_dtype():
     assert audit_step(lambda x: x * 2, jnp.ones((3,), jnp.float32)) == []
 
 
+def test_audit_step_honors_rocketlint_suppressions():
+    """Rocketlint parity: a ``# rocketlint: disable=RKT2xx`` directive in
+    the step function's own source suppresses that rule for the audit —
+    the same reviewable audit trail as the AST linter, instead of
+    'fix the step or don't audit'."""
+    def chatty_but_justified(x):  # rocketlint: disable=RKT203 — debug build
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    assert audit_step(chatty_but_justified, jnp.ones((3,))) == []
+
+    def chatty(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2  # rocketlint: disable=RKT204 — wrong rule: no effect
+
+    assert rules_in(audit_step(chatty, jnp.ones((3,)))) == ["RKT203"]
+
+    def chatty_all(x):
+        y = x.sum()  # rocketlint: disable=all — AST-scoped, NOT audit-wide
+        jax.debug.print("y = {y}", y=y)
+        return x * 2
+
+    # Only explicit RKT2xx ids reach the jaxpr audit: a line-scoped
+    # `disable=all` (or an RKT1xx id) must not blank the whole audit.
+    assert rules_in(audit_step(chatty_all, jnp.ones((3,)))) == ["RKT203"]
+
+
 def test_audit_retraces_budget():
     stable = [{"x": np.ones((8, 4), np.float32)} for _ in range(5)]
     assert audit_retraces(stable, max_traces=1) == []

@@ -1,0 +1,140 @@
+"""The analysis CLI process contract, for both entry forms.
+
+``python -m rocket_tpu.analysis`` (rocketlint over paths) and
+``python -m rocket_tpu.analysis shard`` (the SPMD auditor) must hold the
+same machine contract CI scripts depend on: exit 0 on a clean tree, 1 on
+findings, 2 on usage errors, and one ``--format json`` output shape.
+Everything runs as a real subprocess under ``JAX_PLATFORMS=cpu`` — the
+shard subcommand provisions its own fake 8-device mesh, so no test
+fixture leaks into the contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets")
+
+
+def run_cli(*args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # The CLI must provision its own virtual devices.
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout,
+    )
+
+
+# -- lint form ---------------------------------------------------------------
+
+def test_lint_exit_zero_on_clean_file():
+    proc = run_cli(os.path.join(FIXTURES, "good_tracer_leak.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_exit_one_on_findings_with_json_shape():
+    proc = run_cli("--format", "json",
+                   os.path.join(FIXTURES, "bad_tracer_leak.py"))
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert findings and set(findings[0]) == {"rule", "path", "line",
+                                             "message"}
+    assert any(f["rule"] == "RKT101" for f in findings)
+
+
+def test_lint_exit_two_on_usage_errors():
+    assert run_cli().returncode == 2                      # no paths
+    assert run_cli("--no-such-flag").returncode == 2      # unknown flag
+    assert run_cli("does/not/exist.py").returncode == 2   # bad path
+
+
+def test_list_rules_includes_all_three_families():
+    proc = run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in ("RKT101", "RKT201", "RKT301", "RKT305", "RKT306"):
+        assert rule_id in proc.stdout
+
+
+# -- shard form --------------------------------------------------------------
+
+def test_shard_usage_errors_exit_two():
+    assert run_cli("shard", "--target", "nope").returncode == 2
+    assert run_cli("shard", "--update-budgets").returncode == 2  # no --budgets
+
+
+def test_shard_list_targets():
+    proc = run_cli("shard", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "badrules"):
+        assert name in proc.stdout
+
+
+def test_shard_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: the repo's own rule sets on the repo's own
+    model, under fake 1x8 / 2x4 meshes, with the committed budget files
+    — zero findings, exit 0."""
+    proc = run_cli("shard", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shard_self_provisions_platform_without_env():
+    """The shard form must provision its own CPU backend and 8 virtual
+    devices even when neither JAX_PLATFORMS nor XLA_FLAGS is set (jax is
+    imported by the package __init__ before __main__ runs, so the CLI
+    routes the platform default through jax.config, not just the env)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.analysis", "shard",
+         "--target", "tp_2x4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shard_badrules_reports_dead_replicated_excess():
+    """True positives through the real CLI: the seeded-bad rule set must
+    surface all three finding families, exit 1, in the shared JSON
+    shape."""
+    proc = run_cli("shard", "--target", "badrules", "--format", "json")
+    assert proc.returncode == 1
+    rules = {f["rule"] for f in json.loads(proc.stdout)}
+    assert {"RKT301", "RKT304", "RKT305"} <= rules
+
+
+@pytest.mark.slow
+def test_shard_budget_regression_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: shrink the committed collective-bytes record by half
+    (equivalently: the measured bytes grew 2x) -> RKT306, exit 1; then
+    --update-budgets re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "budgets"
+    budgets_dir.mkdir()
+    committed = json.load(open(os.path.join(BUDGETS, "tp_2x4.json")))
+    committed["collective_bytes_per_step"] = int(
+        committed["collective_bytes_per_step"] * 0.5
+    )
+    (budgets_dir / "tp_2x4.json").write_text(json.dumps(committed))
+
+    proc = run_cli("shard", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT306" in proc.stdout
+    assert "collective_bytes_per_step" in proc.stdout
+
+    proc = run_cli("shard", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+    rebaselined = json.load(open(budgets_dir / "tp_2x4.json"))
+    assert rebaselined["collective_bytes_per_step"] > \
+        committed["collective_bytes_per_step"]
+
+    proc = run_cli("shard", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -145,6 +145,37 @@ def test_write_detail_survives_corrupt_prior(tmp_path):
         assert "mlp" in json.loads(path.read_text())["configs"]
 
 
+def test_write_detail_carries_shard_audit_record(tmp_path):
+    """BENCH_DETAIL.json carries the statically-audited per-device HBM
+    estimate and per-step collective-bytes totals (from the committed
+    SPMD budget records the shard-audit CI gate verifies)."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    audit = json.loads(path.read_text())["shard_audit"]
+    assert audit["hbm_per_device_bytes"] > 0
+    assert audit["collective_bytes_per_step"] > 0
+    assert audit["source"] == "tests/fixtures/budgets"
+    # Per-target breakdown: every committed budget shows up.
+    assert "tp_2x4" in audit["targets"]
+    target = audit["targets"]["tp_2x4"]
+    assert target["collective_bytes_per_step"] > 0
+    assert target["hbm_per_device_bytes"] > 0
+
+
+def test_shard_audit_summary_missing_budgets_is_none(tmp_path):
+    """A checkout without committed budgets must not break emission."""
+    assert bench.shard_audit_summary(str(tmp_path / "nowhere")) is None
+    # And the detail file simply omits the section.
+    path = tmp_path / "BENCH_DETAIL.json"
+    real = bench.BUDGETS_DIR
+    bench.BUDGETS_DIR = str(tmp_path / "nowhere")
+    try:
+        bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    finally:
+        bench.BUDGETS_DIR = real
+    assert "shard_audit" not in json.loads(path.read_text())
+
+
 def test_write_detail_partial_run_keeps_gpt2_headline(tmp_path):
     """The merged record's headline must stay gpt2 after a debug run of
     a different config."""

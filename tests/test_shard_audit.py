@@ -1,0 +1,345 @@
+"""shard_audit: SPMD rule checks (RKT301-304) with true positives and
+clean negatives, HLO collective parsing and the ring cost model, the HBM
+estimator, budget diffs (RKT306), the build-time ShardingRuleError, and
+the compiled self-gate/bad-rules integration targets — all on the 8
+virtual CPU devices the suite already runs under.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocket_tpu.analysis import budgets
+from rocket_tpu.analysis.rules.spmd_rules import (
+    check_collectives,
+    check_dead_rules,
+    check_replication,
+    check_specs,
+)
+from rocket_tpu.analysis.shard_audit import (
+    BUILTIN_TARGETS,
+    CollectiveOp,
+    audit_sharding,
+    estimate_hbm,
+    parse_collectives,
+    resolve_specs,
+    run_target,
+)
+from rocket_tpu.parallel.sharding import ShardingRuleError, make_rules
+
+MESH = {"data": 2, "model": 4}
+
+
+def leaf(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def rules_in(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- HLO collective parsing --------------------------------------------------
+
+HLO = """\
+HloModule jit_step, is_scheduled=true
+
+%fused_computation {
+  ROOT %r = f32[8,64]{1,0} add(f32[8,64]{1,0} %p0, f32[8,64]{1,0} %p1)
+}
+
+ENTRY %main {
+  %ag = f32[64,128]{1,0} all-gather(f32[16,128]{1,0} %p0), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+  %ar.1 = f32[32,128]{1,0} all-reduce(f32[32,128]{1,0} %dot), channel_id=1, replica_groups=[4,2]<=[2,4]T(1,0), to_apply=%add
+  %rs = f32[4,128]{1,0} reduce-scatter(f32[32,128]{1,0} %ar.1), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %ags = (f32[16,16]{1,0}, f32[16,16]{1,0}) all-gather-start(f32[4,16]{1,0} %p1), replica_groups={{0,1,2,3}}
+  %agd = f32[16,16]{1,0} all-gather-done((f32[16,16]{1,0}, f32[16,16]{1,0}) %ags)
+  %cp = bf16[8,8]{1,0} collective-permute(bf16[8,8]{1,0} %p2), source_target_pairs={{0,1},{1,0}}
+  %use = f32[64,128]{1,0} add(f32[64,128]{1,0} %ag, f32[64,128]{1,0} %ag)
+  ROOT %out = f32[] reduce(f32[32,128]{1,0} %ar.1, f32[] %c0), to_apply=%add
+}
+"""
+
+
+def test_parse_collectives_kinds_shapes_groups():
+    ops = parse_collectives(HLO)
+    kinds = [op.kind for op in ops]
+    # -start counted once, -done never, operand mentions never.
+    assert kinds.count("all-gather") == 2
+    assert kinds.count("all-reduce") == 1
+    assert kinds.count("reduce-scatter") == 1
+    assert kinds.count("collective-permute") == 1
+    by_kind = {op.kind: op for op in ops}
+    ag = next(op for op in ops if op.kind == "all-gather")
+    assert ag.shape == (64, 128) and ag.dtype == "f32"
+    assert ag.group_size == 4
+    assert ag.result_bytes == 64 * 128 * 4
+    # iota replica_groups=[4,2]: 4 groups of 2.
+    assert by_kind["all-reduce"].group_size == 2
+    assert by_kind["reduce-scatter"].group_size == 8
+    # Async start: the tuple result is (operand alias, result) — only
+    # the final element is costed, so sync and async forms agree.
+    start = [op for op in ops if op.kind == "all-gather"][1]
+    assert start.result_bytes == 16 * 16 * 4
+    assert start.shape == (16, 16)
+    assert by_kind["collective-permute"].result_bytes == 8 * 8 * 2  # bf16
+
+
+def test_ring_cost_model_monotone_in_kind():
+    ops = parse_collectives(HLO)
+    by_kind = {op.kind: op for op in ops}
+    ar = by_kind["all-reduce"]
+    assert ar.bytes_moved == int(2 * (2 - 1) / 2 * ar.result_bytes)
+    rs = by_kind["reduce-scatter"]
+    assert rs.bytes_moved == (8 - 1) * rs.result_bytes
+    cp = by_kind["collective-permute"]
+    assert cp.bytes_moved == cp.result_bytes
+
+
+def test_parse_collectives_empty_on_collective_free_module():
+    assert parse_collectives("ENTRY %main { ROOT %r = f32[2]{0} add(...) }") == []
+
+
+# -- rule checks: one true positive + one clean negative per rule ------------
+
+def test_dead_rule_fires_on_typo_glob():
+    patterns = (("*/qkv/w_typo", (None, "model")), ("wte/table", ("model",)))
+    paths = [("blocks", "0", "qkv", "w"), ("wte", "table")]
+    findings = check_dead_rules(patterns, paths)
+    assert rules_in(findings) == ["RKT301"]
+    assert "w_typo" in findings[0].message
+
+
+def test_dead_rule_clean_when_every_glob_matches():
+    patterns = (("*/qkv/w", (None, "model")),)
+    assert check_dead_rules(patterns, [("blocks", "0", "qkv", "w")]) == []
+
+
+def test_dead_rule_fires_on_shadowed_glob():
+    """First-match-wins: a later glob whose every match is claimed by an
+    earlier rule never applies its spec — dead, even though it matches."""
+    patterns = (("*/w", (None, "model")),
+                ("*/fc_out/w", ("model", None)))  # fully shadowed
+    paths = [("mlp", "fc_in", "w"), ("mlp", "fc_out", "w")]
+    findings = check_dead_rules(patterns, paths)
+    assert rules_in(findings) == ["RKT301"]
+    assert "shadowed" in findings[0].message
+    # Reordered so the specific rule wins first: both alive.
+    assert check_dead_rules(tuple(reversed(patterns)), paths) == []
+
+
+def test_spec_rank_mismatch_fires():
+    specs = [(("ln", "scale"), leaf(128), ("data", "model"))]
+    findings = check_specs(specs, MESH)
+    assert rules_in(findings) == ["RKT302"]
+
+
+def test_axis_indivisible_and_unknown_axis_fire():
+    specs = [
+        (("a",), leaf(50, 64), ("model", None)),    # 50 % 4 != 0
+        (("b",), leaf(64, 64), ("expert", None)),   # no such mesh axis
+        (("c",), leaf(64, 64), (("data", "model"), None)),  # 64 % 8 == 0: ok
+        # Multi-axis entry splits by the PRODUCT: 4 % (2*4) != 0 even
+        # though 4 divides by "data" and by "model" individually.
+        (("d",), leaf(4, 64), (("data", "model"), None)),
+    ]
+    findings = check_specs(specs, MESH)
+    assert rules_in(findings) == ["RKT303"]
+    assert len(findings) == 3
+
+
+def test_replicated_large_param_fires_only_under_sharding_rulesets():
+    big = leaf(1024, 1024)  # 4 MiB
+    sharded = [(("w1",), big, ("model", None)), (("w2",), big, None)]
+    findings = check_replication(sharded, MESH, replicated_bytes_limit=1 << 20)
+    assert rules_in(findings) == ["RKT304"] and "w2" in findings[0].message
+    # A rule set sharding NOTHING is a deliberate replicated layout.
+    replicated = [(("w1",), big, None), (("w2",), big, None)]
+    assert check_replication(replicated, MESH) == []
+    # ...and an all-None spec counts as replicated, not sharded.
+    allnone = [(("w1",), big, (None, None)), (("w2",), big, ("model", None))]
+    assert len(check_replication(allnone, MESH, replicated_bytes_limit=1)) == 1
+
+
+def test_excess_collective_allowlist():
+    ops = [
+        CollectiveOp("all-gather", "f32", (8, 8), 4, 256, 192),
+        CollectiveOp("all-gather", "f32", (8, 8), 4, 256, 192),
+        CollectiveOp("all-reduce", "f32", (8,), 8, 32, 56),
+    ]
+    findings = check_collectives(ops, {"all-gather": 1, "all-to-all": 0})
+    assert rules_in(findings) == ["RKT305"]
+    assert "2 all-gather" in findings[0].message
+    assert check_collectives(ops, {"all-gather": 2}) == []
+    assert check_collectives(ops, None) == []  # stats-only mode
+
+
+# -- make_rules build-time validation (satellite bugfix) ---------------------
+
+def test_make_rules_raises_structured_error_on_overlong_spec():
+    rule_fn = make_rules([("*/qkv/w", ("data", "model", None))])
+    with pytest.raises(ShardingRuleError) as err:
+        rule_fn(("blocks", "0", "qkv", "w"), leaf(64, 192))
+    assert err.value.pattern == "*/qkv/w"
+    assert err.value.shape == (64, 192)
+    assert "*/qkv/w" in str(err.value)
+
+
+def test_make_rules_still_pads_stacked_and_allows_short_specs():
+    rule_fn = make_rules([("*/qkv/w", (None, "model"))])
+    # Stacked subtree: leading layer dim left-padded, no error.
+    assert rule_fn(("blocks_stacked", "qkv", "w"), leaf(2, 64, 192)) == \
+        (None, None, "model")
+    # Short spec outside stacked keeps trailing-replicated meaning.
+    assert rule_fn(("blocks", "0", "qkv", "w"), leaf(64, 192)) == \
+        (None, "model")
+    assert rule_fn.patterns == ((("*/qkv/w"), (None, "model")),)
+
+
+def test_resolve_specs_converts_rule_error_to_finding():
+    rule_fn = make_rules([("w", ("data", "model"))])
+    triples, findings = resolve_specs(rule_fn, {"w": leaf(64)})
+    assert rules_in(findings) == ["RKT302"]
+    assert triples[0][2] is None  # audit continues with replicated
+
+
+# -- HBM estimator -----------------------------------------------------------
+
+def test_estimate_hbm_shape_math():
+    specs = [
+        (("w1",), leaf(64, 128), ("model", None)),       # / 4
+        (("w2",), leaf(64, 128), (("data", "model"),)),  # / 8
+        (("b",), leaf(128), None),                       # replicated
+    ]
+    est = estimate_hbm(specs, MESH, optimizer_slots=2)
+    expect = (64 * 128 * 4) // 4 + (64 * 128 * 4) // 8 + 128 * 4
+    assert est["params_bytes"] == expect
+    assert est["optimizer_bytes"] == 2 * expect
+    assert est["activation_bytes"] is None
+    assert est["method"] == "shape-math"
+    assert est["total_bytes"] == 3 * expect
+
+
+# -- budget files and the regression gate ------------------------------------
+
+def record(collective=1000, hbm=2000):
+    return {"collective_bytes_per_step": collective,
+            "hbm_per_device_bytes": hbm, "collective_counts": {}}
+
+
+def test_budget_roundtrip_and_diff(tmp_path):
+    budgets.write_budget(str(tmp_path), "t", record())
+    committed = budgets.load_budget(str(tmp_path), "t")
+    assert committed["collective_bytes_per_step"] == 1000
+    # Within tolerance: clean. Past it: RKT306 naming the key.
+    assert budgets.diff_budget("t", committed, record(1099, 2199)) == []
+    findings = budgets.diff_budget("t", committed, record(1111, 2000))
+    assert rules_in(findings) == ["RKT306"]
+    assert "collective_bytes_per_step" in findings[0].message
+    # Shrinking is an improvement, never a failure.
+    assert budgets.diff_budget("t", committed, record(10, 20)) == []
+
+
+def test_budget_zero_baseline_growth_still_gates():
+    """Growth from a committed zero is infinite — it must fail, not slip
+    through the relative-growth math."""
+    findings = budgets.diff_budget("t", record(0, 2000), record(500, 2000))
+    assert rules_in(findings) == ["RKT306"]
+    assert "zero baseline" in findings[0].message
+    # Zero to zero stays clean.
+    assert budgets.diff_budget("t", record(0, 2000), record(0, 2000)) == []
+
+
+def test_budget_missing_is_a_finding(tmp_path):
+    assert budgets.load_budget(str(tmp_path), "absent") is None
+    findings = budgets.diff_budget("absent", None, record())
+    assert rules_in(findings) == ["RKT306"]
+    assert "--update-budgets" in findings[0].message
+
+
+def test_budget_corrupt_file_reads_as_missing(tmp_path):
+    (tmp_path / "bad.json").write_text("{not json")
+    assert budgets.load_budget(str(tmp_path), "bad") is None
+
+
+# -- integration: compiled audits on the fake mesh ---------------------------
+
+def test_audit_sharding_flags_indivisible_before_compile():
+    rule_fn = make_rules([("w", ("model", None))])
+    variables = {"params": {"w": jnp.zeros((10, 8))}}  # 10 % 4 != 0
+
+    def step(variables, batch):
+        return jnp.sum(variables["params"]["w"]) + jnp.sum(batch["x"])
+
+    report = audit_sharding(
+        step, variables, {"x": jnp.zeros((8, 8))},
+        rules=rule_fn, mesh_shape=MESH,
+    )
+    assert "RKT303" in rules_in(report.findings)
+
+
+@pytest.mark.slow
+def test_builtin_self_gate_targets_are_clean():
+    """The repo's own rule sets on the repo's own model: zero findings
+    on every non-demo target (the in-process version of the CLI gate)."""
+    for name, target in BUILTIN_TARGETS.items():
+        if target.demo:
+            continue
+        report = run_target(target)
+        assert report.findings == [], (
+            name + ":\n" + "\n".join(f.render() for f in report.findings)
+        )
+        assert report.record["collective_bytes_per_step"] > 0
+        assert report.record["hbm_per_device_bytes"] > 0
+
+
+def test_badrules_target_reports_all_three_families():
+    """The seeded-bad rule set: dead glob, silently replicated params,
+    excess collectives — the true-positive fixture for the CLI."""
+    report = run_target(BUILTIN_TARGETS["badrules"])
+    assert {"RKT301", "RKT304", "RKT305"} <= set(rules_in(report.findings))
+
+
+# -- strict-mode surfacing ---------------------------------------------------
+
+def test_note_collectives_records_and_module_publishes(tmp_path):
+    import optax
+
+    import rocket_tpu as rt
+    from rocket_tpu import optim
+    from rocket_tpu.core.attributes import Attributes
+    from rocket_tpu.models.mlp import MLP
+    from rocket_tpu.runtime.context import Runtime
+
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        strict=True,
+    )
+
+    def cross_entropy(batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            batch["logits"], batch["label"]
+        ).mean()
+
+    model = MLP(in_features=8, num_classes=4, hidden=(16,))
+    module = rt.Module(
+        model,
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    module.bind(runtime)
+    module.setup(None)
+    try:
+        assert runtime.strict.note_collectives("train_step[MLP]", 17) == 17
+        assert runtime.strict.collective_counts["train_step[MLP]"] == 17
+        attrs = Attributes(mode="train", tracker=Attributes(scalars={}))
+        attrs.batch = runtime.shard_batch({
+            "image": np.zeros((64, 8), np.float32),
+            "label": np.zeros((64,), np.int32),
+        })
+        module.launch(attrs)
+        assert attrs.tracker.scalars["audited_collectives"] == 17
+        assert "retraces" in attrs.tracker.scalars
+    finally:
+        module.destroy(None)
+        runtime.end_training()
