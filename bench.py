@@ -601,16 +601,18 @@ BUDGETS_DIR = os.path.join(
 )
 
 
-def shard_audit_summary(budgets_dir=BUDGETS_DIR):
-    """The audited per-device HBM estimate and per-step collective-bytes
-    totals for the repo's canonical sharded configs, read from the
-    checked-in budget records the SPMD self-gate verifies every CI run
-    (the audit itself runs on fake CPU meshes — re-running it here would
-    duplicate the gate, not the measurement). None when no budgets are
-    committed; never raises — BENCH emission must survive a missing or
-    corrupt record."""
+def _budget_summary(budgets_dir, keys_attr, source):
+    """Shared reader for the committed audit-budget records: per-target
+    gated keys (named by ``keys_attr`` on the budgets module, resolved
+    inside the guard) plus a worst-case (max) headline per key. None
+    when no budgets are committed; never raises — BENCH emission must
+    survive a missing or corrupt record. (The audits themselves run in
+    CI — re-running them here would duplicate the gate, not the
+    measurement.)"""
     try:
-        from rocket_tpu.analysis.budgets import GATED_KEYS, load_budget
+        from rocket_tpu.analysis import budgets as budgets_mod
+        keys = getattr(budgets_mod, keys_attr)
+        load_budget = budgets_mod.load_budget
         names = sorted(
             os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
             if f.endswith(".json")
@@ -620,21 +622,42 @@ def shard_audit_summary(budgets_dir=BUDGETS_DIR):
             record = load_budget(budgets_dir, name)
             if record is None:
                 continue
-            targets[name] = {key: record.get(key) for key in GATED_KEYS}
+            targets[name] = {key: record.get(key) for key in keys}
         if not targets:
             return None
-        return {
-            "targets": targets,
-            "hbm_per_device_bytes": max(
-                t["hbm_per_device_bytes"] or 0 for t in targets.values()
-            ),
-            "collective_bytes_per_step": max(
-                t["collective_bytes_per_step"] or 0 for t in targets.values()
-            ),
-            "source": "tests/fixtures/budgets",
-        }
+        summary = {"targets": targets, "source": source}
+        for key in keys:
+            summary[key] = max(t[key] or 0 for t in targets.values())
+        return summary
     except Exception:  # noqa: BLE001 — emission must never die on this
         return None
+
+
+def shard_audit_summary(budgets_dir=BUDGETS_DIR):
+    """The audited per-device HBM estimate and per-step collective-bytes
+    totals for the repo's canonical sharded configs, from the records
+    the SPMD self-gate verifies every CI run."""
+    return _budget_summary(
+        budgets_dir, "GATED_KEYS", "tests/fixtures/budgets"
+    )
+
+
+#: Numerics-budget directory the precision auditor maintains
+#: (``python -m rocket_tpu.analysis prec --update-budgets``).
+PREC_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "prec",
+)
+
+
+def prec_audit_summary(budgets_dir=PREC_BUDGETS_DIR):
+    """The audited mixed-precision numbers (fp32-bytes fraction of the
+    traced step's values, widen/narrow cast counts — worst across
+    targets) from the records the precision self-gate verifies every CI
+    run."""
+    return _budget_summary(
+        budgets_dir, "PREC_GATED_KEYS", "tests/fixtures/budgets/prec"
+    )
 
 
 def write_detail(results, path=DETAIL_PATH):
@@ -675,6 +698,11 @@ def write_detail(results, path=DETAIL_PATH):
         # Statically-audited SPMD cost alongside the measured throughput:
         # per-device HBM estimate + per-step collective bytes per target.
         detail["shard_audit"] = audit
+    prec = prec_audit_summary(PREC_BUDGETS_DIR)
+    if prec is not None:
+        # Statically-audited numerics next to the measured throughput:
+        # fp32-bytes fraction of the traced step + cast counts per target.
+        detail["prec_audit"] = prec
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

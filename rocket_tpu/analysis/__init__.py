@@ -18,6 +18,12 @@ rule catalog):
   module, and per-device HBM / collective-bytes budgets
   (:mod:`~rocket_tpu.analysis.budgets`). CLI:
   ``python -m rocket_tpu.analysis shard``.
+* :mod:`~rocket_tpu.analysis.prec_audit` — dtype-flow audit of the
+  mixed-precision convention: the traced step's jaxpr walked with a
+  per-value precision provenance; low-precision accumulation, sub-fp32
+  softmax internals, state/collective narrowing, cast churn, uncast
+  master params, and per-target numerics budgets (fp32-bytes fraction +
+  cast counts). CLI: ``python -m rocket_tpu.analysis prec``.
 * strict mode — ``Runtime(strict=True)`` (``runtime/context.py``): a
   ``jax.transfer_guard`` plus a retrace counter enforcing the same
   contracts on a live run; the SPMD auditor's collective count is
@@ -33,10 +39,16 @@ from rocket_tpu.analysis.findings import (
     emit_findings,
     parse_suppressions,
 )
+from rocket_tpu.analysis.prec_audit import (
+    PrecAuditReport,
+    audit_precision,
+    collect_dtype_flow,
+)
 from rocket_tpu.analysis.rocketlint import lint_file, lint_paths, lint_source
 from rocket_tpu.analysis.rules import (
     AST_RULES,
     AUDIT_RULES,
+    PREC_RULES,
     SPMD_RULES,
     all_rules,
 )
@@ -66,8 +78,12 @@ __all__ = [
     "ShardAuditReport",
     "estimate_hbm",
     "parse_collectives",
+    "audit_precision",
+    "PrecAuditReport",
+    "collect_dtype_flow",
     "AST_RULES",
     "AUDIT_RULES",
     "SPMD_RULES",
+    "PREC_RULES",
     "all_rules",
 ]

@@ -1,23 +1,30 @@
-"""CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``... shard``.
+"""CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` | ``prec``.
 
-Two entry points, one process contract (exit 0 = clean, 1 = findings,
+Three entry points, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
 (:func:`~rocket_tpu.analysis.findings.emit_findings`):
 
 * the default (path) form lints files/directories with every rocketlint
   rule — the shape CI wants (``scripts/check.sh`` wires it together
-  with ruff, the SPMD self-gate and the tier-1 tests);
+  with ruff, the self-gates and the tier-1 tests);
 * ``shard`` audits the repo's canonical (model, rule-set, mesh)
   pairings with the static SPMD auditor
   (:mod:`rocket_tpu.analysis.shard_audit`): dead sharding rules,
   rank/divisibility mismatches, silently replicated params, excess
   collectives in the *compiled* module, and HBM/collective-bytes
-  budgets (``--budgets`` dir, ``--update-budgets`` to re-baseline).
+  budgets (``--budgets`` dir, ``--update-budgets`` to re-baseline);
+* ``prec`` audits the dtype flow of the repo's canonical train/eval
+  steps (:mod:`rocket_tpu.analysis.prec_audit`): low-precision
+  accumulation, sub-fp32 softmax internals, state narrowing, cast
+  churn, uncast master params, and the numerics budgets (fp32-bytes
+  fraction + cast counts; same ``--budgets``/``--update-budgets``
+  contract — the budget gate runs only when ``--budgets`` is given;
+  CI passes the canonical ``tests/fixtures/budgets/prec``).
 
 The jaxpr-audit rules (RKT2xx) need a concrete step function and
 example inputs, so they run from code/tests via
 :func:`rocket_tpu.analysis.audit_step`, not from this CLI;
-``--list-rules`` documents all three families.
+``--list-rules`` documents all four families.
 """
 
 from __future__ import annotations
@@ -31,9 +38,9 @@ from rocket_tpu.analysis.rocketlint import lint_paths
 from rocket_tpu.analysis.rules import all_rules
 
 
-def _shard_main(argv) -> int:
-    # The auditor compiles under fake meshes: default to the CPU backend
-    # with 8 virtual devices unless the caller chose a platform. XLA_FLAGS
+def _provision_cpu_backend() -> None:
+    # The auditors run on fake devices: default to the CPU backend with
+    # 8 virtual devices unless the caller chose a platform. XLA_FLAGS
     # is read at client creation, so the env is early enough — but jax was
     # already imported by the package __init__ and froze JAX_PLATFORMS
     # into its config, so the platform default must go through
@@ -49,25 +56,27 @@ def _shard_main(argv) -> int:
     if getattr(jax.config, "jax_platforms", None) in (None, ""):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from rocket_tpu.analysis import budgets as budgets_mod
-    from rocket_tpu.analysis.shard_audit import BUILTIN_TARGETS, run_target
 
-    parser = argparse.ArgumentParser(
-        prog="python -m rocket_tpu.analysis shard",
-        description="static SPMD sharding / collective-traffic / "
-                    "HBM-budget audit on fake CPU meshes",
-    )
+def _audit_main(argv, *, prog, description, targets, run_target,
+                budgets_help, list_line, budget_keys, budget_rule,
+                family) -> int:
+    """Shared scaffolding for the ``shard`` and ``prec`` subcommands:
+    one flag set, one demo-skip sweep, one budget write/diff loop — so
+    the two audit CLIs cannot drift apart."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    parser = argparse.ArgumentParser(prog=prog, description=description)
     parser.add_argument(
-        "--target", action="append", choices=sorted(BUILTIN_TARGETS),
+        "--target", action="append", choices=sorted(targets),
         help="audit only these targets (default: every non-demo target)",
     )
     parser.add_argument("--list-targets", action="store_true",
                         help="print the target catalog and exit")
     parser.add_argument(
         "--budgets", default=None, metavar="DIR",
-        help="budget-file directory (e.g. tests/fixtures/budgets): diff "
-        "each target against its committed record and fail on "
-        f">{budgets_mod.TOLERANCE * 100:.0f}%% growth",
+        help=f"{budgets_help}: diff each target against its committed "
+        f"record and fail on >{budgets_mod.TOLERANCE * 100:.0f}%% growth "
+        "(no DIR = findings only, no budget gate)",
     )
     parser.add_argument(
         "--update-budgets", action="store_true",
@@ -82,21 +91,19 @@ def _shard_main(argv) -> int:
     args = parser.parse_args(argv)
 
     if args.list_targets:
-        for name, target in sorted(BUILTIN_TARGETS.items()):
-            mesh = "x".join(str(s) for s in target.mesh_shape.values())
+        for name, target in sorted(targets.items()):
             tag = "  [demo]" if target.demo else ""
-            print(f"{name:14s} mesh={mesh} "
-                  f"({dict(target.mesh_shape)}){tag}")
+            print(f"{name:14s} {list_line(target)}{tag}")
         return 0
     if args.update_budgets and not args.budgets:
         parser.error("--update-budgets requires --budgets DIR")
 
     names = args.target or [
-        name for name, target in BUILTIN_TARGETS.items() if not target.demo
+        name for name, target in targets.items() if not target.demo
     ]
     findings = []
     for name in names:
-        target = BUILTIN_TARGETS[name]
+        target = targets[name]
         report = run_target(target)
         findings.extend(report.findings)
         if target.demo or not args.budgets:
@@ -107,21 +114,74 @@ def _shard_main(argv) -> int:
             findings.extend(budgets_mod.diff_budget(
                 name, budgets_mod.load_budget(args.budgets, name),
                 report.record, tolerance=args.tolerance,
+                keys=budget_keys, rule=budget_rule, family=family,
             ))
 
     emit_findings(findings, fmt=args.format)
     return 1 if findings else 0
 
 
+def _shard_main(argv) -> int:
+    _provision_cpu_backend()
+
+    from rocket_tpu.analysis import budgets as budgets_mod
+    from rocket_tpu.analysis.shard_audit import BUILTIN_TARGETS, run_target
+
+    return _audit_main(
+        argv,
+        prog="python -m rocket_tpu.analysis shard",
+        description="static SPMD sharding / collective-traffic / "
+                    "HBM-budget audit on fake CPU meshes",
+        targets=BUILTIN_TARGETS,
+        run_target=run_target,
+        budgets_help=f"budget-file directory "
+                     f"(canonical: {budgets_mod.DEFAULT_DIR})",
+        list_line=lambda t: (
+            f"mesh={'x'.join(str(s) for s in t.mesh_shape.values())} "
+            f"({dict(t.mesh_shape)})"
+        ),
+        budget_keys=budgets_mod.GATED_KEYS,
+        budget_rule="RKT306",
+        family="spmd",
+    )
+
+
+def _prec_main(argv) -> int:
+    # The dtype-flow walk is pure abstract evaluation, but sharing the
+    # backend bootstrap keeps the subcommands interchangeable in CI and
+    # lets user steps traced here contain shard_map collectives.
+    _provision_cpu_backend()
+
+    from rocket_tpu.analysis import budgets as budgets_mod
+    from rocket_tpu.analysis.prec_audit import PREC_TARGETS, run_prec_target
+
+    return _audit_main(
+        argv,
+        prog="python -m rocket_tpu.analysis prec",
+        description="static dtype-flow / mixed-precision audit of the "
+                    "repo's canonical train/eval steps",
+        targets=PREC_TARGETS,
+        run_target=run_prec_target,
+        budgets_help=f"numerics-budget directory "
+                     f"(canonical: {budgets_mod.PREC_DIR})",
+        list_line=lambda t: f"compute={t.compute_dtype.__name__}",
+        budget_keys=budgets_mod.PREC_GATED_KEYS,
+        budget_rule="RKT406",
+        family="prec",
+    )
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "shard":
         return _shard_main(argv[1:])
+    if argv and argv[0] == "prec":
+        return _prec_main(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.analysis",
         description="rocketlint: static analysis for rocket_tpu fast "
-                    "paths (see also the `shard` subcommand)",
+                    "paths (see also the `shard` and `prec` subcommands)",
     )
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")

@@ -1,4 +1,4 @@
-"""Checked-in SPMD cost budgets and the regression gate (RKT306).
+"""Checked-in audit budgets and the regression gates (RKT306 / RKT406).
 
 A budget file is one JSON record per audit target
 (``tests/fixtures/budgets/<target>.json``) holding the numbers the
@@ -17,6 +17,12 @@ that replicates a weight matrix shows up here as a collective-bytes or
 HBM jump long before anyone runs on hardware. Shrinking is never an
 error (improvements re-baseline via ``--update-budgets``).
 
+The precision auditor shares this machinery for its NUMERICS budgets
+(``tests/fixtures/budgets/prec/<target>.json``, gated keys
+``PREC_GATED_KEYS``, rule RKT406, CLI ``python -m rocket_tpu.analysis
+prec``): a dropped cast-at-use shows up as an fp32-bytes-fraction jump,
+a cast storm as a widen/narrow count jump.
+
 This module's own code is plain-JSON bookkeeping (``bench.py`` reuses
 it to stamp the audited numbers into BENCH_DETAIL.json) — note that
 importing it still executes ``rocket_tpu.analysis.__init__`` and so
@@ -28,13 +34,14 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Mapping, Optional
+from typing import Mapping, Optional, Tuple
 
 from rocket_tpu.analysis.findings import Finding
 
 __all__ = [
     "TOLERANCE",
     "GATED_KEYS",
+    "PREC_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -44,12 +51,19 @@ __all__ = [
 #: Allowed relative growth over the committed budget before RKT306 fires.
 TOLERANCE = 0.10
 
-#: Record keys the regression gate compares (monotone cost metrics only —
-#: counts are context, not gates).
+#: Record keys the SPMD regression gate compares (monotone cost metrics
+#: only — counts are context, not gates).
 GATED_KEYS = ("collective_bytes_per_step", "hbm_per_device_bytes")
 
+#: Record keys the numerics (precision) gate compares — RKT406. The
+#: fraction gates fp32 memory creep; the cast counts gate HLO churn.
+PREC_GATED_KEYS = ("fp32_bytes_fraction", "widen_casts", "narrow_casts")
+
 #: Default budgets directory, resolved relative to the repo checkout.
+#: The precision budgets live in a ``prec/`` subdirectory so BENCH's
+#: per-target sweep over ``*.json`` never mixes the two record shapes.
 DEFAULT_DIR = os.path.join("tests", "fixtures", "budgets")
+PREC_DIR = os.path.join(DEFAULT_DIR, "prec")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -83,23 +97,37 @@ def diff_budget(
     committed: Optional[Mapping],
     measured: Mapping,
     tolerance: float = TOLERANCE,
+    keys: Tuple[str, ...] = GATED_KEYS,
+    rule: str = "RKT306",
+    family: str = "spmd",
 ) -> list[Finding]:
-    """RKT306 findings for ``measured`` vs the ``committed`` record.
+    """Budget-regression findings for ``measured`` vs the ``committed``
+    record — RKT306 with the SPMD defaults, RKT406 when the precision
+    auditor calls with ``keys=PREC_GATED_KEYS``.
 
     A missing budget file is itself a finding — a new audit target must
     land with its baseline (run ``--update-budgets``), or CI would
     silently gate nothing.
     """
-    path = f"<spmd:{target}>"
+    path = f"<{family}:{target}>"
+    subcommand = "shard" if family == "spmd" else "prec"
     if committed is None:
         return [Finding(
-            "RKT306", path, 0,
+            rule, path, 0,
             "budget-regression: no committed budget for this target — "
-            "run `python -m rocket_tpu.analysis shard --update-budgets` "
-            "and commit tests/fixtures/budgets/",
+            f"run `python -m rocket_tpu.analysis {subcommand} "
+            "--update-budgets` and commit the budget directory",
         )]
+    def fmt(value) -> str:
+        # Byte/count keys are ints and keep their exact digits (two
+        # measurements must never render identically unless equal);
+        # fractions print compact.
+        if isinstance(value, int):
+            return f"{value:,}"
+        return f"{value:.4g}"
+
     findings = []
-    for key in GATED_KEYS:
+    for key in keys:
         old = committed.get(key)
         new = measured.get(key)
         if not isinstance(old, (int, float)) or not isinstance(new, (int, float)):
@@ -109,18 +137,18 @@ def diff_budget(
             # gate exists for most; never silently pass it.
             if new > 0:
                 findings.append(Finding(
-                    "RKT306", path, 0,
+                    rule, path, 0,
                     f"budget-regression: {key} grew from a zero baseline "
-                    f"to {new:,.0f} bytes — if intended, re-baseline with "
+                    f"to {fmt(new)} — if intended, re-baseline with "
                     "--update-budgets",
                 ))
             continue
         growth = (new - old) / old
         if growth > tolerance:
             findings.append(Finding(
-                "RKT306", path, 0,
+                rule, path, 0,
                 f"budget-regression: {key} grew {growth * 100:.1f}% "
-                f"({old:,.0f} -> {new:,.0f} bytes; tolerance "
+                f"({fmt(old)} -> {fmt(new)}; tolerance "
                 f"{tolerance * 100:.0f}%) — if intended, re-baseline with "
                 "--update-budgets",
             ))

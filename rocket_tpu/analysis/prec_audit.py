@@ -1,0 +1,766 @@
+"""prec_audit — dtype-flow audit of the mixed-precision convention.
+
+The framework's speed rests on bf16 compute; its *correctness* rests on
+the places that must NOT be bf16: fp32 master params cast at use
+(``nn/layers.py``), fp32 softmax/logsumexp internals, fp32 accumulation
+in large/grouped matmuls and reductions, and state that round-trips the
+step at full precision. None of that is visible at a call site and none
+of it is enforced by jax — ``preferred_element_type=lhs.dtype`` on a
+grouped matmul compiles and trains, it just trains slightly wrong.
+
+This pass abstract-evals the **real** train/eval step (the same
+``jax.eval_shape`` harness the SPMD auditor uses — no FLOPs, no device)
+and walks the jaxpr propagating a per-value precision provenance:
+
+* where each value ORIGINATED (an fp32 master param, a batch input, a
+  computed intermediate);
+* its master dtype at origin and where it was first NARROWED below it
+  (the cast-at-use point);
+* whether it was WIDENED by an explicit cast (a deliberate fp32
+  island, e.g. the MoE router) and the immediate cast source (for
+  detecting widen-then-narrow-back churn).
+
+The collected facts feed the RKT4xx rules
+(:mod:`rocket_tpu.analysis.rules.prec_rules`): low-precision
+accumulation (RKT401), sub-fp32 exp/log-family transcendentals
+(RKT402), state/collective narrowing (RKT403), cast churn (RKT404),
+params never cast at use (RKT405), and a checked-in per-target
+numerics budget — fp32-bytes fraction of the step's traced values plus
+widen/narrow cast counts — with the same >10% regression gate and
+``--update-budgets`` writer as the SPMD budgets (RKT406,
+:mod:`rocket_tpu.analysis.budgets`).
+
+CLI: ``python -m rocket_tpu.analysis prec`` audits the repo's own
+canonical step configurations (the self-gate CI runs via
+``scripts/check.sh``). Library entry: :func:`audit_precision` for user
+steps. A ``# rocketlint: disable=RKT4xx`` comment inside the step
+function's own source suppresses that rule for the audit (same
+contract as ``trace_audit.audit_step``). docs/analysis.md has the
+workflow and the rule table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.rules.prec_rules import (
+    TRANSCENDENTAL_PRIMS,
+    check_accumulation,
+    check_cast_churn,
+    check_collective_operands,
+    check_state_dtypes,
+    check_transcendentals,
+    check_uncast_params,
+    is_float,
+    is_sub32_float,
+)
+from rocket_tpu.analysis.trace_audit import _fn_suppressed_rules
+
+__all__ = [
+    "DtypeFlow",
+    "PrecAuditReport",
+    "audit_precision",
+    "collect_dtype_flow",
+    "PREC_TARGETS",
+    "run_prec_target",
+]
+
+
+# -- facts the walk collects -------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DotFact:
+    """One matmul-family eqn with a visible accumulator dtype."""
+
+    prim: str                 # "dot_general" | "ragged_dot" | "conv"
+    acc_dtype: Any            # preferred_element_type or the output dtype
+    contract_size: int        # elements summed per output element
+    lhs_shape: Tuple[int, ...]
+    rhs_shape: Tuple[int, ...]
+    param_path: Tuple[str, ...] = ()  # first param operand's path, if any
+
+
+@dataclass(frozen=True)
+class ReduceFact:
+    prim: str
+    dtype: Any
+    factor: int               # elements summed per output element
+
+
+@dataclass(frozen=True)
+class TransFact:
+    prim: str
+    dtype: Any
+    shape: Tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class CollectiveFact:
+    prim: str
+    dtype: Any
+    param_path: Tuple[str, ...]
+    master_dtype: Any
+    narrowed_at: str
+
+
+@dataclass(frozen=True)
+class ParamUseFact:
+    prim: str
+    param_path: Tuple[str, ...]
+    nbytes: int
+
+
+@dataclass
+class DtypeFlow:
+    """Everything one walk collected: rule facts plus the byte/cast
+    statistics the numerics budget gates."""
+
+    dots: list = field(default_factory=list)
+    reduces: list = field(default_factory=list)
+    trans: list = field(default_factory=list)
+    collectives: list = field(default_factory=list)
+    uncast_params: list = field(default_factory=list)
+    widen_casts: int = 0
+    narrow_casts: int = 0
+    churn_count: int = 0
+    churn_elems: int = 0
+    fp32_value_bytes: int = 0
+    float_value_bytes: int = 0
+
+
+# -- the provenance lattice --------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Prov:
+    """Per-value provenance: where a value came from and what precision
+    events happened to it on the way."""
+
+    dtype: Any
+    origin: str = "compute"        # "param" | "state" | "input" | "compute"
+    path: Tuple[str, ...] = ()     # pytree path when origin is param/state
+    master_dtype: Any = None       # dtype at origin
+    narrowed_at: Optional[str] = None  # primitive where first narrowed
+    widened_from: Any = None       # set by an explicit widening cast
+    cast_from: Any = None          # immediate convert source (churn chains)
+
+
+def _prov_for_aval(aval, origin="input", path=()):
+    dtype = getattr(aval, "dtype", None)
+    return _Prov(dtype=dtype, origin=origin, path=tuple(path),
+                 master_dtype=dtype)
+
+
+#: dtype-preserving ops that forward their first operand's provenance.
+#: ``gather``/``dynamic_slice`` index into operand 0 (an embedding pick
+#: keeps the table's provenance), ``pad`` pads it.
+_TRANSPARENT = frozenset({
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "slice",
+    "dynamic_slice", "gather", "rev", "copy", "stop_gradient", "name",
+    "pad", "expand_dims",
+})
+
+#: Manual-collective primitives RKT403 watches (shard_map bodies; GSPMD
+#: collectives exist only post-compile and are the SPMD auditor's job).
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "pmax", "pmin",
+})
+
+#: eqn param names that can hold a call-like sub-jaxpr (pjit bodies,
+#: remat, custom_jvp/vjp, shard_map). When the inner invar count matches
+#: the eqn's, the mapping is positional and provenance threads through.
+_CALL_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _merge_provs(a: _Prov, b: _Prov) -> _Prov:
+    """Join two provenances for one value (cond branches): agreement is
+    kept, disagreement degrades toward "compute", and narrowing is
+    sticky — if either path narrowed, the merged value counts as
+    narrowed."""
+    if a == b:
+        return a
+    same_origin = a.origin == b.origin and a.path == b.path
+    return _Prov(
+        dtype=a.dtype,
+        origin=a.origin if same_origin else "compute",
+        path=a.path if same_origin else (),
+        master_dtype=a.master_dtype
+        if a.master_dtype == b.master_dtype else a.dtype,
+        narrowed_at=a.narrowed_at or b.narrowed_at,
+        widened_from=a.widened_from
+        if a.widened_from == b.widened_from else None,
+        cast_from=a.cast_from if a.cast_from == b.cast_from else None,
+    )
+
+
+def _as_open(jaxpr_like):
+    return jaxpr_like.jaxpr if hasattr(jaxpr_like, "jaxpr") else jaxpr_like
+
+
+def _aval_nbytes(aval) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(np.dtype(dtype), "itemsize", 4) if dtype is not None else 4
+    n = 1
+    for dim in shape:
+        n *= int(dim)
+    return n * itemsize
+
+
+def _numel(shape) -> int:
+    n = 1
+    for dim in shape or ():
+        n *= int(dim)
+    return n
+
+
+class _Walker:
+    """Recursive jaxpr walk threading the provenance environment."""
+
+    def __init__(self, flow: DtypeFlow):
+        self.flow = flow
+
+    # -- env plumbing ------------------------------------------------------
+
+    def _read(self, env, var) -> _Prov:
+        try:
+            prov = env.get(var)
+        except TypeError:  # Literals are unhashable in some jax versions
+            prov = None
+        if prov is None:
+            prov = _prov_for_aval(var.aval, origin="compute")
+        return prov
+
+    def _count_bytes(self, outvars):
+        for var in outvars:
+            dtype = getattr(var.aval, "dtype", None)
+            if not is_float(dtype):
+                continue
+            nbytes = _aval_nbytes(var.aval)
+            self.flow.float_value_bytes += nbytes
+            if np.dtype(dtype).itemsize >= 4:
+                self.flow.fp32_value_bytes += nbytes
+
+    # -- primitive handlers ------------------------------------------------
+
+    def _handle_convert(self, eqn, in_provs):
+        src = in_provs[0]
+        out = eqn.outvars[0]
+        dst_dtype = getattr(out.aval, "dtype", None)
+        narrowed_at = src.narrowed_at
+        widened_from = None
+        cast_from = src.dtype
+        if is_float(src.dtype) and is_float(dst_dtype):
+            src_size = np.dtype(src.dtype).itemsize
+            dst_size = np.dtype(dst_dtype).itemsize
+            if dst_size < src_size:
+                self.flow.narrow_casts += 1
+                master = src.master_dtype if is_float(src.master_dtype) \
+                    else src.dtype
+                if (narrowed_at is None
+                        and dst_size < np.dtype(master).itemsize):
+                    narrowed_at = "convert_element_type"
+                # Churn: this narrow lands back on the dtype the value was
+                # widened FROM, with only transparent ops in between.
+                if (src.cast_from is not None
+                        and is_float(src.cast_from)
+                        and np.dtype(src.cast_from) == np.dtype(dst_dtype)
+                        and src.widened_from is not None):
+                    self.flow.churn_count += 1
+                    self.flow.churn_elems += _numel(
+                        getattr(out.aval, "shape", ())
+                    )
+            elif dst_size > src_size:
+                self.flow.widen_casts += 1
+                widened_from = src.dtype
+        return _Prov(
+            dtype=dst_dtype, origin=src.origin, path=src.path,
+            master_dtype=src.master_dtype or src.dtype,
+            narrowed_at=narrowed_at, widened_from=widened_from,
+            cast_from=cast_from,
+        )
+
+    def _record_dot(self, eqn, in_provs, compute_dtype):
+        name = eqn.primitive.name
+        lhs_aval = eqn.invars[0].aval
+        rhs_aval = eqn.invars[1].aval
+        acc = eqn.params.get("preferred_element_type") or getattr(
+            eqn.outvars[0].aval, "dtype", None
+        )
+        if name == "dot_general":
+            (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+            contract = _numel(
+                [lhs_aval.shape[i] for i in lhs_contract]
+            ) if lhs_contract else 1
+            prim = "dot_general"
+        elif name in ("ragged_dot", "ragged_dot_general"):
+            # (m, k) x (g, k, n): groups chain partial sums over k. On
+            # newer jax the primitive is ragged_dot_general with nested
+            # dimension numbers; fall back to the trailing lhs dim.
+            try:
+                rdn = eqn.params["ragged_dot_dimension_numbers"]
+                (lhs_contract, _), _ = rdn.dot_dimension_numbers
+                contract = _numel([lhs_aval.shape[i] for i in lhs_contract])
+            except Exception:
+                contract = int(lhs_aval.shape[-1])
+            prim = "ragged_dot"
+        else:  # conv_general_dilated
+            dn = eqn.params.get("dimension_numbers")
+            rhs_shape = tuple(rhs_aval.shape)
+            try:
+                out_feature_dim = dn.rhs_spec[0]
+            except Exception:
+                out_feature_dim = len(rhs_shape) - 1
+            contract = _numel(
+                [s for i, s in enumerate(rhs_shape) if i != out_feature_dim]
+            )
+            prim = "conv"
+        param_path = ()
+        for prov in in_provs[:2]:
+            if prov.origin == "param" and prov.path:
+                param_path = prov.path
+                break
+        self.flow.dots.append(DotFact(
+            prim=prim, acc_dtype=acc, contract_size=int(contract),
+            lhs_shape=tuple(lhs_aval.shape), rhs_shape=tuple(rhs_aval.shape),
+            param_path=param_path,
+        ))
+        # RKT405 half: an un-narrowed fp32 master param in the dot while
+        # the OTHER operand was not explicitly widened (a widened operand
+        # marks a deliberate fp32 island, e.g. the MoE router).
+        if compute_dtype is not None and is_sub32_float(compute_dtype):
+            for idx, prov in enumerate(in_provs[:2]):
+                if prov.origin != "param" or prov.narrowed_at is not None:
+                    continue
+                if not is_float(prov.dtype) \
+                        or np.dtype(prov.dtype).itemsize < 4:
+                    continue
+                other = in_provs[1 - idx]
+                if other.widened_from is not None:
+                    continue
+                self.flow.uncast_params.append(ParamUseFact(
+                    prim=prim, param_path=prov.path,
+                    nbytes=_aval_nbytes(eqn.invars[idx].aval),
+                ))
+
+    # -- the walk ----------------------------------------------------------
+
+    def walk(self, jaxpr, env, compute_dtype):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_provs = [self._read(env, v) for v in eqn.invars]
+
+            # recursion into sub-jaxprs ---------------------------------
+            if name not in ("scan", "while", "cond"):
+                sub_like = next(
+                    (eqn.params[k] for k in _CALL_JAXPR_KEYS
+                     if hasattr(eqn.params.get(k), "eqns")
+                     or hasattr(eqn.params.get(k), "jaxpr")),
+                    None,
+                )
+                if sub_like is not None:
+                    sub = _as_open(sub_like)
+                    if len(sub.invars) == len(eqn.invars):
+                        sub_env = dict(zip(sub.invars, in_provs))
+                    else:
+                        # Consts or an unknown calling convention: local
+                        # rules still run; provenance doesn't thread.
+                        sub_env = {
+                            v: _prov_for_aval(v.aval) for v in sub.invars
+                        }
+                    out_provs = self.walk(sub, sub_env, compute_dtype)
+                    for var, prov in zip(eqn.outvars, out_provs):
+                        env[var] = _Prov(
+                            dtype=getattr(var.aval, "dtype", None),
+                            origin=prov.origin, path=prov.path,
+                            master_dtype=prov.master_dtype,
+                            narrowed_at=prov.narrowed_at,
+                            widened_from=prov.widened_from,
+                            cast_from=prov.cast_from,
+                        )
+                    continue
+            if name == "scan":
+                sub = _as_open(eqn.params["jaxpr"])
+                sub_env = dict(zip(sub.invars, in_provs))
+                out_provs = self.walk(sub, sub_env, compute_dtype)
+                # outvars = carry + stacked ys, positional with sub outs.
+                for var, prov in zip(eqn.outvars, out_provs):
+                    env[var] = _Prov(
+                        dtype=getattr(var.aval, "dtype", None),
+                        origin=prov.origin, path=prov.path,
+                        master_dtype=prov.master_dtype,
+                        narrowed_at=prov.narrowed_at,
+                        widened_from=prov.widened_from,
+                        cast_from=prov.cast_from,
+                    )
+                continue
+            if name == "while":
+                cond_n = eqn.params.get("cond_nconsts", 0)
+                body_n = eqn.params.get("body_nconsts", 0)
+                cond = _as_open(eqn.params["cond_jaxpr"])
+                body = _as_open(eqn.params["body_jaxpr"])
+                self.walk(cond, dict(zip(
+                    cond.invars,
+                    in_provs[:cond_n] + in_provs[cond_n + body_n:],
+                )), compute_dtype)
+                body_provs = in_provs[cond_n:]
+                out_provs = self.walk(
+                    body, dict(zip(body.invars, body_provs)), compute_dtype
+                )
+                for var, prov in zip(eqn.outvars, out_provs):
+                    env[var] = prov
+                continue
+            if name == "cond":
+                # Merge across branches: where they disagree the merged
+                # provenance degrades to "compute", and a narrowing in ANY
+                # branch survives (state/collective narrowing must not
+                # hide behind an identity branch).
+                merged = None
+                for branch in eqn.params["branches"]:
+                    sub = _as_open(branch)
+                    out_provs = self.walk(
+                        sub, dict(zip(sub.invars, in_provs[1:])),
+                        compute_dtype,
+                    )
+                    merged = out_provs if merged is None else [
+                        _merge_provs(a, b)
+                        for a, b in zip(merged, out_provs)
+                    ]
+                for var, prov in zip(eqn.outvars, merged or ()):
+                    env[var] = prov
+                continue
+            # Unknown higher-order eqn (pallas_call, ...): recurse with a
+            # fresh env — local rules (accumulation, transcendentals,
+            # churn) still see the inner eqns; provenance doesn't thread.
+            subjaxprs = [
+                _as_open(v) for v in eqn.params.values()
+                if hasattr(v, "eqns") or hasattr(v, "jaxpr")
+            ]
+            if subjaxprs:
+                for sub in subjaxprs:
+                    self.walk(
+                        sub,
+                        {v: _prov_for_aval(v.aval) for v in sub.invars},
+                        compute_dtype,
+                    )
+                for var in eqn.outvars:
+                    env[var] = _prov_for_aval(var.aval, origin="compute")
+                continue
+
+            # leaf eqns -------------------------------------------------
+            self._count_bytes(eqn.outvars)
+
+            if name == "convert_element_type":
+                env[eqn.outvars[0]] = self._handle_convert(eqn, in_provs)
+                continue
+            if name in _TRANSPARENT and in_provs:
+                src = in_provs[0]
+                for var in eqn.outvars:
+                    env[var] = _Prov(
+                        dtype=getattr(var.aval, "dtype", None),
+                        origin=src.origin, path=src.path,
+                        master_dtype=src.master_dtype,
+                        narrowed_at=src.narrowed_at,
+                        widened_from=src.widened_from,
+                        cast_from=src.cast_from,
+                    )
+                continue
+            if name in ("dot_general", "ragged_dot", "ragged_dot_general",
+                        "conv_general_dilated"):
+                self._record_dot(eqn, in_provs, compute_dtype)
+            elif name in ("reduce_sum", "reduce_window_sum"):
+                out_aval = eqn.outvars[0].aval
+                dtype = getattr(out_aval, "dtype", None)
+                if is_float(dtype):
+                    in_elems = _numel(getattr(eqn.invars[0].aval, "shape", ()))
+                    out_elems = max(1, _numel(getattr(out_aval, "shape", ())))
+                    self.flow.reduces.append(ReduceFact(
+                        prim=name, dtype=dtype,
+                        factor=in_elems // out_elems,
+                    ))
+            elif name in TRANSCENDENTAL_PRIMS:
+                out_aval = eqn.outvars[0].aval
+                self.flow.trans.append(TransFact(
+                    prim=name, dtype=getattr(out_aval, "dtype", None),
+                    shape=tuple(getattr(out_aval, "shape", ())),
+                ))
+            elif name in _COLLECTIVE_PRIMS:
+                for prov, var in zip(in_provs, eqn.invars):
+                    if (prov.origin == "param"
+                            and prov.narrowed_at is not None):
+                        self.flow.collectives.append(CollectiveFact(
+                            prim=name,
+                            dtype=getattr(var.aval, "dtype", None),
+                            param_path=prov.path,
+                            master_dtype=prov.master_dtype,
+                            narrowed_at=prov.narrowed_at,
+                        ))
+
+            for var in eqn.outvars:
+                env[var] = _prov_for_aval(var.aval, origin="compute")
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+
+# -- public API --------------------------------------------------------------
+
+
+def _path_names(key_path) -> Tuple[str, ...]:
+    from rocket_tpu.utils.pytree import key_path_names
+
+    return key_path_names(key_path)
+
+
+def collect_dtype_flow(
+    step_fn: Callable,
+    variables,
+    batch,
+    compute_dtype=None,
+) -> tuple[DtypeFlow, dict, dict]:
+    """Trace ``step_fn(variables, batch)`` abstractly and walk its jaxpr.
+
+    Returns ``(flow, in_dtypes, out_dtypes)`` where the dtype maps are
+    path-keyed over the ``variables`` tree and the step's output tree
+    (for the RKT403 suffix match). Inputs may be concrete arrays or
+    ``ShapeDtypeStruct``s — nothing is materialized.
+    """
+    closed, out_shape = jax.make_jaxpr(step_fn, return_shape=True)(
+        variables, batch
+    )
+    jaxpr = closed.jaxpr
+
+    flow = DtypeFlow()
+    env: dict = {}
+    var_iter = iter(jaxpr.invars)
+    in_dtypes: dict = {}
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0]:
+        var = next(var_iter)
+        path = _path_names(key_path)
+        origin = "param" if path and path[0] == "params" else "state"
+        if not (isinstance(variables, dict) and "params" in variables):
+            origin = "param"
+        env[var] = _Prov(
+            dtype=getattr(var.aval, "dtype", None), origin=origin,
+            path=path, master_dtype=getattr(var.aval, "dtype", None),
+        )
+        in_dtypes[path] = getattr(var.aval, "dtype", None)
+    for _key_path, _leaf in jax.tree_util.tree_flatten_with_path(batch)[0]:
+        var = next(var_iter)
+        env[var] = _prov_for_aval(var.aval, origin="input")
+
+    _Walker(flow).walk(jaxpr, env, compute_dtype)
+
+    out_dtypes = {
+        _path_names(key_path): getattr(leaf, "dtype", None)
+        for key_path, leaf in
+        jax.tree_util.tree_flatten_with_path(out_shape)[0]
+    }
+    return flow, in_dtypes, out_dtypes
+
+
+@dataclass
+class PrecAuditReport:
+    """Findings plus the numerics record the budget gate (and BENCH
+    emission) consumes."""
+
+    label: str
+    findings: list = field(default_factory=list)
+    flow: Optional[DtypeFlow] = None
+    record: dict = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def audit_precision(
+    step_fn: Callable,
+    variables,
+    batch,
+    *,
+    compute_dtype=None,
+    dot_contract_min: int = 2048,
+    reduce_factor_min: int = 4096,
+    fp32_compute_bytes_min: int = 1 << 16,
+    max_cast_churn: int = 0,
+    check_state: bool = True,
+    label: str = "step",
+) -> PrecAuditReport:
+    """Audit the dtype flow of ``step_fn(variables, batch)``.
+
+    ``compute_dtype`` declares the step's intended activation dtype
+    (e.g. ``jnp.bfloat16``); RKT405 only fires when it is declared and
+    sub-fp32. ``check_state=False`` skips the RKT403 input/output
+    compare (eval steps that return predictions, not state). Pure
+    abstract evaluation — no FLOPs, no device, no params materialized.
+
+    A ``# rocketlint: disable=RKT4xx`` directive anywhere in ``fn``'s own
+    source suppresses that rule for this audit (trace_audit parity —
+    dtype findings carry no source line, so the directive scopes to the
+    audited function).
+    """
+    suppressed = _fn_suppressed_rules(step_fn, prefix="RKT4")
+    flow, in_dtypes, out_dtypes = collect_dtype_flow(
+        step_fn, variables, batch, compute_dtype=compute_dtype
+    )
+
+    findings: list[Finding] = []
+    findings.extend(check_accumulation(
+        flow.dots, flow.reduces, dot_contract_min=dot_contract_min,
+        reduce_factor_min=reduce_factor_min, label=label,
+    ))
+    findings.extend(check_transcendentals(flow.trans, label=label))
+    if check_state:
+        findings.extend(check_state_dtypes(
+            in_dtypes, out_dtypes, label=label
+        ))
+    findings.extend(check_collective_operands(flow.collectives, label=label))
+    findings.extend(check_cast_churn(
+        flow.churn_count, flow.churn_elems, max_churn=max_cast_churn,
+        label=label,
+    ))
+    findings.extend(check_uncast_params(
+        flow.uncast_params, compute_dtype,
+        fp32_compute_bytes_min=fp32_compute_bytes_min, label=label,
+    ))
+    if suppressed:
+        findings = [f for f in findings if f.rule not in suppressed]
+
+    total = max(1, flow.float_value_bytes)
+    record = {
+        "fp32_bytes_fraction": round(flow.fp32_value_bytes / total, 4),
+        "fp32_value_bytes": int(flow.fp32_value_bytes),
+        "float_value_bytes": int(flow.float_value_bytes),
+        "widen_casts": int(flow.widen_casts),
+        "narrow_casts": int(flow.narrow_casts),
+        "cast_churn": int(flow.churn_count),
+        "compute_dtype": str(np.dtype(compute_dtype))
+        if compute_dtype is not None else None,
+    }
+    return PrecAuditReport(
+        label=label, findings=findings, flow=flow, record=record
+    )
+
+
+# -- builtin targets: the repo's own canonical step configurations -----------
+
+
+@dataclass(frozen=True)
+class PrecTarget:
+    """One self-gate configuration the CLI audits.
+
+    Names pair with the SPMD audit targets (the same model/step
+    pairings own both budget files), but the precision audit walks the
+    traced jaxpr, which is mesh-independent — so the targets differ by
+    what they TRACE: unrolled vs ``scan_layers`` blocks, the
+    gelu/learned/layernorm/tied GPT-2 layer set vs the
+    swiglu/rope/rmsnorm Llama set, train vs eval.
+    """
+
+    name: str
+    #: () -> (step_fn, variables, batch, check_state)
+    build: Callable[[], tuple]
+    compute_dtype: Any = jnp.bfloat16
+    demo: bool = False
+
+
+def _bf16_train_parts(**overrides):
+    from rocket_tpu.analysis.shard_audit import _lm_config, _lm_parts
+
+    config = _lm_config(activation_dtype="bfloat16", **overrides)
+    step_fn, variables, batch, _rules, _donate = _lm_parts(
+        None, config=config
+    )
+    return step_fn, variables, batch, True
+
+
+def _tp_parts():
+    return _bf16_train_parts()
+
+
+def _scan_parts():
+    return _bf16_train_parts(scan_layers=True)
+
+
+def _gpt2_layerset_parts():
+    return _bf16_train_parts(
+        pos_embedding="learned", norm="layernorm", mlp="gelu",
+        tied_embeddings=True,
+    )
+
+
+def _eval_parts():
+    from rocket_tpu.analysis.shard_audit import _lm_config, _lm_parts
+
+    config = _lm_config(activation_dtype="bfloat16")
+    step_fn, variables, batch, _rules, _donate = _lm_parts(
+        None, train=False, config=config
+    )
+    return step_fn, variables, batch, False
+
+
+def _badprec_parts():
+    """Seeded-bad step for the true-positive fixture tests: a bf16
+    accumulation over a 4096-long contraction (RKT401), a bf16 softmax
+    (RKT402), EMA state narrowed to bf16 on the way out (RKT403), a
+    bf16->f32->bf16 round trip (RKT404), and a 8 MiB fp32 param fed to a
+    matmul uncast (RKT405)."""
+    variables = {
+        "params": {
+            "w_big": jax.ShapeDtypeStruct((4096, 256), jnp.float32),
+            "emb": jax.ShapeDtypeStruct((4096, 512), jnp.float32),
+        },
+        "state": {"ema": jax.ShapeDtypeStruct((4096, 256), jnp.float32)},
+    }
+    batch = {
+        "x": jax.ShapeDtypeStruct((8, 4096), jnp.bfloat16),
+        "x32": jax.ShapeDtypeStruct((8, 4096), jnp.float32),
+    }
+
+    def bad_step(variables, batch):
+        p = variables["params"]
+        h = batch["x"] @ p["w_big"].astype(jnp.bfloat16)    # RKT401
+        probs = jax.nn.softmax(h, axis=-1)                  # RKT402
+        churn = h.astype(jnp.float32).astype(jnp.bfloat16)  # RKT404
+        z = batch["x32"] @ p["emb"]                         # RKT405
+        ema = (
+            0.9 * variables["state"]["ema"]
+            + 0.1 * (batch["x32"].T @ h.astype(jnp.float32))
+        ).astype(jnp.bfloat16)                              # RKT403
+        loss = (
+            probs.astype(jnp.float32).mean()
+            + churn.astype(jnp.float32).mean()
+            + z.mean()
+        )
+        return {"params": p, "state": {"ema": ema}}, loss
+
+    return bad_step, variables, batch, True
+
+
+#: name -> target. The default sweep runs the non-demo entries.
+PREC_TARGETS: dict[str, PrecTarget] = {
+    target.name: target
+    for target in (
+        PrecTarget(name="tp_2x4", build=_tp_parts),
+        PrecTarget(name="tp_1x8", build=_scan_parts),
+        PrecTarget(name="fsdp_1x8", build=_gpt2_layerset_parts),
+        PrecTarget(name="tp_2x4_eval", build=_eval_parts),
+        PrecTarget(name="badprec", build=_badprec_parts, demo=True),
+    )
+}
+
+
+def run_prec_target(target: PrecTarget) -> PrecAuditReport:
+    step_fn, variables, batch, check_state = target.build()
+    return audit_precision(
+        step_fn, variables, batch,
+        compute_dtype=target.compute_dtype,
+        check_state=check_state, label=target.name,
+    )

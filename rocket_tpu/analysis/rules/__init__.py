@@ -1,13 +1,15 @@
-"""Rule registry: rocketlint (AST), trace auditor (jaxpr), SPMD auditor.
+"""Rule registry: rocketlint (AST), trace/SPMD/precision auditors.
 
 Every rule has a stable id (``RKT1xx`` = AST lint, ``RKT2xx`` = jaxpr
-audit, ``RKT3xx`` = SPMD audit), a short slug, and a one-line contract
-used by ``--list-rules`` and docs/analysis.md. AST rules expose
-``check(ctx) -> Iterable[Finding]`` over a
-:class:`~rocket_tpu.analysis.rocketlint.FileContext`; jaxpr rules are
-applied by :mod:`rocket_tpu.analysis.trace_audit`; SPMD rules by
-:mod:`rocket_tpu.analysis.shard_audit` (their check functions live in
-:mod:`rocket_tpu.analysis.rules.spmd_rules`).
+audit, ``RKT3xx`` = SPMD audit, ``RKT4xx`` = precision audit), a short
+slug, and a one-line contract used by ``--list-rules`` and
+docs/analysis.md. AST rules expose ``check(ctx) -> Iterable[Finding]``
+over a :class:`~rocket_tpu.analysis.rocketlint.FileContext`; jaxpr
+rules are applied by :mod:`rocket_tpu.analysis.trace_audit`; SPMD rules
+by :mod:`rocket_tpu.analysis.shard_audit`; precision rules by
+:mod:`rocket_tpu.analysis.prec_audit` (check functions in
+:mod:`rocket_tpu.analysis.rules.spmd_rules` /
+:mod:`rocket_tpu.analysis.rules.prec_rules`).
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ from rocket_tpu.analysis.rules.capsule_rules import (
     HandlerSignatureRule,
     LaunchHostSyncRule,
 )
+from rocket_tpu.analysis.rules.dtype_rules import StringDtypeRule
 from rocket_tpu.analysis.rules.host_rules import (
     ForkStartMethodRule,
     SyncInLoopRule,
@@ -25,9 +28,11 @@ from rocket_tpu.analysis.rules.jit_rules import (
     JitSideEffectRule,
     TracerLeakRule,
 )
+from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
 from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
-__all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "all_rules"]
+__all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
+           "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -38,6 +43,7 @@ AST_RULES = (
     HandlerSignatureRule(),
     LaunchHostSyncRule(),
     ForkStartMethodRule(),
+    StringDtypeRule(),
 )
 
 #: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.
@@ -65,6 +71,9 @@ AUDIT_RULES = (
 
 def all_rules():
     """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
-    (RKT2xx) and SPMD audit (RKT3xx) — in id order."""
+    (RKT2xx), SPMD audit (RKT3xx) and precision audit (RKT4xx) — in id
+    order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
-    return tuple(sorted(ast_meta + list(AUDIT_RULES) + list(SPMD_RULES)))
+    return tuple(sorted(
+        ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
+    ))

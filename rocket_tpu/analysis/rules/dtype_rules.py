@@ -1,0 +1,47 @@
+"""AST rules over dtype spelling.
+
+The dtype-flow auditor (``RKT4xx``) reasons about casts it can see in a
+jaxpr; this sibling keeps the *source* spelling of dtypes analyzable.
+A string-literal dtype (``x.astype("float32")``) typechecks nothing,
+greps differently from the canonical ``jnp.float32`` (so a precision
+sweep misses it), and a typo inside the string survives until runtime
+on exactly the code path that was not tested. One canonical spelling
+makes the cast-at-use convention auditable with a text search.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["StringDtypeRule"]
+
+
+class StringDtypeRule:
+    rule_id = "RKT108"
+    slug = "string-dtype"
+    contract = (
+        "a string-literal dtype (x.astype(\"float32\")) instead of the "
+        "canonical jnp.float32: invisible to dtype greps/audits and a "
+        "typo inside the string only fails at runtime"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for call in ctx.walk_calls():
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "astype"):
+                continue
+            candidates = list(call.args[:1]) + [
+                kw.value for kw in call.keywords if kw.arg == "dtype"
+            ]
+            for arg in candidates:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    yield Finding(
+                        self.rule_id, ctx.path, call.lineno,
+                        f".astype({arg.value!r}) uses a string-literal "
+                        f"dtype — spell it jnp.{arg.value} so dtype flow "
+                        "stays greppable and typos fail at import, not "
+                        "mid-run",
+                    )

@@ -1,0 +1,275 @@
+"""Precision audit rules (``RKT4xx``) — checks over the dtype flow of a
+traced step.
+
+The bf16-compute / fp32-master convention (``nn/layers.py``) and the
+"reductions stay fp32" discipline only hold if every call site keeps
+them — and nothing in jax enforces either: a ``preferred_element_type``
+left at the operand dtype silently accumulates a grouped matmul in
+bf16, a softmax applied to a bf16 tensor runs its ``exp`` at 8 mantissa
+bits, and an EMA update that round-trips through the compute dtype
+quietly erodes the master weights. This family machine-checks the
+convention on what a step *traced to*.
+
+The dtype-flow walk (provenance lattice, fact collection, builtin
+targets) lives in :mod:`rocket_tpu.analysis.prec_audit`; this module
+holds the catalog plus the checks that map collected facts to
+:class:`~rocket_tpu.analysis.findings.Finding`s, so the rule logic is
+testable without tracing anything.
+
+Deliberate non-rules: bf16 matmuls with bf16 accumulators *below* the
+contraction threshold are the mixed-precision convention itself (the
+MXU accumulates a single dot in f32 internally and rounds once), and
+bounded activations (tanh/erf/logistic — gelu, silu) are numerically
+safe at bf16, so only the exp/log family counts for RKT402.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "PREC_RULES",
+    "TRANSCENDENTAL_PRIMS",
+    "is_float",
+    "is_sub32_float",
+    "check_accumulation",
+    "check_transcendentals",
+    "check_state_dtypes",
+    "check_collective_operands",
+    "check_cast_churn",
+    "check_uncast_params",
+]
+
+#: (id, slug, contract) — the catalog, same shape as SPMD_RULES.
+PREC_RULES = (
+    ("RKT401", "low-precision-accumulation",
+     "a large matmul/einsum/reduction accumulates below fp32 (missing "
+     "preferred_element_type=jnp.float32): rounding error grows with the "
+     "contraction length; grouped matmuls (ragged_dot/gmm) chain partial "
+     "sums and are flagged at any size"),
+    ("RKT402", "sub-fp32-transcendental",
+     "softmax/logsumexp/cross-entropy internals (exp/exp2/log/log1p) run "
+     "below fp32: 8 mantissa bits flatten near-tied probabilities and "
+     "overflow at |x| > 88"),
+    ("RKT403", "state-narrowed",
+     "optimizer/EMA/model state leaves the step narrower than it "
+     "entered, or a cross-device collective moves a param narrowed from "
+     "its master dtype: master-weight precision erodes a little every "
+     "step"),
+    ("RKT404", "cast-churn",
+     "a value is widened and immediately narrowed back (bf16->f32->bf16) "
+     "with nothing in between: dead converts that inflate the HLO and "
+     "hide where precision actually changes"),
+    ("RKT405", "param-never-cast",
+     "a large fp32 master param reaches a matmul uncast while the step "
+     "declares a sub-fp32 compute dtype: silent fp32 compute (~2x MXU "
+     "time); deliberate fp32 islands widen their activations explicitly "
+     "and stay exempt"),
+    ("RKT406", "numerics-budget-regression",
+     "the fp32-bytes fraction or widen/narrow cast counts of the traced "
+     "step grew more than the tolerance over the checked-in numerics "
+     "budget file"),
+)
+
+#: Primitives whose sub-fp32 execution RKT402 flags: the exp/log family
+#: (softmax, logsumexp, cross-entropy internals). Bounded activations
+#: (tanh/erf/logistic) are excluded by design — see the module docstring.
+TRANSCENDENTAL_PRIMS = frozenset({"exp", "exp2", "log", "log1p"})
+
+
+def _prec_path(label: str) -> str:
+    return f"<prec:{label}>"
+
+
+def is_float(dtype) -> bool:
+    """ml_dtypes-aware float check (bfloat16's numpy kind is 'V', so a
+    plain ``.kind == 'f'`` test misses exactly the dtype this auditor
+    exists for)."""
+    if dtype is None:
+        return False
+    return bool(jnp.issubdtype(np.dtype(dtype), jnp.floating))
+
+
+def is_sub32_float(dtype) -> bool:
+    """True for float dtypes narrower than 32 bits (bf16, f16, fp8s)."""
+    return is_float(dtype) and np.dtype(dtype).itemsize < 4
+
+
+def check_accumulation(
+    dots: Sequence,   # prec_audit.DotFact
+    reduces: Sequence,  # prec_audit.ReduceFact
+    dot_contract_min: int = 2048,
+    reduce_factor_min: int = 4096,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT401 over collected dot/reduce facts.
+
+    A single dot below ``dot_contract_min`` keeps the MXU's internal f32
+    accumulate + one rounding and passes; at or above it (and for
+    grouped ``ragged_dot``/``gmm`` at ANY size — partial sums chain
+    across group boundaries) a sub-fp32 accumulator is flagged.
+    Reductions compare the per-output reduce factor against
+    ``reduce_factor_min``.
+    """
+    findings = []
+    for dot in dots:
+        if not is_sub32_float(dot.acc_dtype):
+            continue
+        grouped = dot.prim != "dot_general"
+        if not grouped and dot.contract_size < dot_contract_min:
+            continue
+        where = f" (param {'/'.join(dot.param_path)})" if dot.param_path else ""
+        findings.append(Finding(
+            "RKT401", _prec_path(label), 0,
+            f"low-precision-accumulation: {dot.prim} "
+            f"{dot.lhs_shape}x{dot.rhs_shape} accumulates in "
+            f"{dot.acc_dtype} over a {dot.contract_size}-long contraction"
+            + (" with grouped partial sums" if grouped else "")
+            + f"{where} — pass preferred_element_type=jnp.float32 and "
+            "downcast the result",
+        ))
+    for red in reduces:
+        if not is_sub32_float(red.dtype) or red.factor < reduce_factor_min:
+            continue
+        findings.append(Finding(
+            "RKT401", _prec_path(label), 0,
+            f"low-precision-accumulation: {red.prim} sums {red.factor} "
+            f"elements per output in {red.dtype} — accumulate in fp32 "
+            "(sum the .astype(jnp.float32) operand, downcast after)",
+        ))
+    return findings
+
+
+def check_transcendentals(
+    trans: Sequence,  # prec_audit.TransFact
+    label: str = "step",
+) -> list[Finding]:
+    """RKT402: exp/log-family primitives executing below fp32."""
+    findings = []
+    for fact in trans:
+        if not is_sub32_float(fact.dtype):
+            continue
+        findings.append(Finding(
+            "RKT402", _prec_path(label), 0,
+            f"sub-fp32-transcendental: {fact.prim} on {fact.dtype}"
+            f"{list(fact.shape)} — softmax/logsumexp internals need fp32 "
+            "(cast the operand up; jax.nn.softmax inherits its input "
+            "dtype)",
+        ))
+    return findings
+
+
+def check_state_dtypes(
+    in_dtypes: Mapping[Tuple[str, ...], object],
+    out_dtypes: Mapping[Tuple[str, ...], object],
+    label: str = "step",
+) -> list[Finding]:
+    """RKT403 (state half): any variables leaf that leaves the step as a
+    narrower float than it entered. Matching is by path suffix — the
+    step's output tree usually nests the updated variables under a tuple
+    index, so ``(0, "params", "w")`` matches the input ``("params", "w")``.
+    """
+    findings = []
+    out_items = list(out_dtypes.items())
+    for in_path, in_dtype in in_dtypes.items():
+        if not is_float(in_dtype):
+            continue
+        in_np = np.dtype(in_dtype)
+        for out_path, out_dtype in out_items:
+            if len(out_path) < len(in_path):
+                continue
+            if tuple(out_path[-len(in_path):]) != tuple(in_path):
+                continue
+            if not is_float(out_dtype):
+                continue
+            out_np = np.dtype(out_dtype)
+            if out_np.itemsize < in_np.itemsize:
+                findings.append(Finding(
+                    "RKT403", _prec_path(label), 0,
+                    f"state-narrowed: {'/'.join(str(p) for p in in_path)} "
+                    f"enters the step as {in_np} but leaves as {out_np} — "
+                    "master weights / optimizer state must round-trip at "
+                    "full precision (cast compute copies, not the state)",
+                ))
+    return findings
+
+
+def check_collective_operands(
+    collectives: Sequence,  # prec_audit.CollectiveFact
+    label: str = "step",
+) -> list[Finding]:
+    """RKT403 (collective half): a cross-device collective whose operand
+    was narrowed from a param's master dtype — the reduction/gather then
+    happens at compute precision and every device keeps the eroded copy."""
+    findings = []
+    for fact in collectives:
+        findings.append(Finding(
+            "RKT403", _prec_path(label), 0,
+            f"state-narrowed: collective {fact.prim} moves "
+            f"{'/'.join(fact.param_path) or 'a param'} narrowed "
+            f"{fact.master_dtype}->{fact.dtype} at {fact.narrowed_at} — "
+            "collectives over master state run at the master dtype",
+        ))
+    return findings
+
+
+def check_cast_churn(
+    churn_count: int,
+    churn_elems: int,
+    max_churn: int = 0,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT404: widen-then-narrow-back round trips (aggregated — one
+    finding per audit, the count is the signal)."""
+    if churn_count <= max_churn:
+        return []
+    return [Finding(
+        "RKT404", _prec_path(label), 0,
+        f"cast-churn: {churn_count} widen-then-narrow-back convert "
+        f"chains ({churn_elems:,} elements round-tripped) — e.g. "
+        "bf16->f32->bf16 with nothing in between; drop the dead pair or "
+        "move the fp32 work inside the widened window",
+    )]
+
+
+def check_uncast_params(
+    uses: Sequence,  # prec_audit.ParamUseFact
+    compute_dtype,
+    fp32_compute_bytes_min: int = 1 << 16,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT405: fp32 master params reaching matmuls uncast while the step
+    declares a sub-fp32 compute dtype.
+
+    Exemptions built into the fact collection: the *other* dot operand
+    was explicitly widened (a deliberate fp32 island, e.g. an MoE router
+    computing ``x.astype(f32) @ w``), or the param itself was narrowed
+    upstream (the convention working as intended). Small params are
+    exempt below ``fp32_compute_bytes_min`` — an fp32 bias or norm scale
+    is policy, not a hazard.
+    """
+    if compute_dtype is None or not is_sub32_float(compute_dtype):
+        return []
+    findings = []
+    seen: set = set()
+    for use in uses:
+        if use.nbytes < fp32_compute_bytes_min:
+            continue
+        if use.param_path in seen:
+            continue
+        seen.add(use.param_path)
+        findings.append(Finding(
+            "RKT405", _prec_path(label), 0,
+            f"param-never-cast: {'/'.join(use.param_path)} "
+            f"({use.nbytes / 2**20:.2f} MiB fp32) feeds {use.prim} uncast "
+            f"under a declared {np.dtype(compute_dtype)} compute dtype — "
+            "silent fp32 compute; cast at use "
+            "(w.astype(x.dtype)) or widen the activation explicitly for "
+            "a deliberate fp32 island",
+        ))
+    return findings

@@ -457,24 +457,29 @@ class AuditTarget:
     demo: bool = False
 
 
-def _lm_config():
+def _lm_config(**overrides):
     """Tiny swiglu/untied/rope TransformerLM: small enough to compile in
     ~2 s on CPU, shaped so EVERY glob in ``gpt2_tp_rules`` is live (gelu
     or tied configs would leave fc_gate / head globs legitimately dead —
-    scope the audit's rule set to the model it places)."""
+    scope the audit's rule set to the model it places). ``overrides``
+    parameterize variants for the other audits (the precision targets
+    trace bf16, scan-layers and gelu/tied flavors of this same model)."""
     from rocket_tpu.models.transformer import TransformerConfig
 
-    return TransformerConfig(
+    base = dict(
         vocab_size=256, max_seq_len=64, dim=128, num_layers=2,
         num_heads=8, pos_embedding="rope", norm="rmsnorm", mlp="swiglu",
         tied_embeddings=False, dropout=0.0,
     )
+    base.update(overrides)
+    return TransformerConfig(**base)
 
 
-def _lm_parts(rules, *, train: bool = True, batch_size: int = 16):
+def _lm_parts(rules, *, train: bool = True, batch_size: int = 16,
+              config=None):
     from rocket_tpu.models.transformer import TransformerLM
 
-    model = TransformerLM(_lm_config())
+    model = TransformerLM(config if config is not None else _lm_config())
     variables = jax.eval_shape(model.init, jax.random.key(0))
     batch = {
         "tokens": jax.ShapeDtypeStruct(

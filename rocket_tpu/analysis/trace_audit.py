@@ -52,12 +52,13 @@ def _trace_path(label: str) -> str:
     return f"<trace:{label}>"
 
 
-def _fn_suppressed_rules(fn: Callable) -> set:
+def _fn_suppressed_rules(fn: Callable, prefix: str = "RKT2") -> set:
     """Rule ids disabled by ``# rocketlint: disable=...`` directives in
     the step function's own source (rocketlint-parity for the jaxpr
-    audit). Jaxpr findings have no line numbers, so a directive anywhere
-    in the function body applies to the whole audit of that function —
-    which is exactly why only EXPLICIT jaxpr-family ids (``RKT2xx``)
+    audit; the precision auditor reuses this with ``prefix="RKT4"``).
+    Jaxpr findings have no line numbers, so a directive anywhere in the
+    function body applies to the whole audit of that function — which is
+    exactly why only EXPLICIT ids of the auditing family (``prefix``)
     count here: a line-scoped ``disable=all`` or an AST-rule id placed
     to silence rocketlint must not blank the entire jaxpr audit.
     Functions without retrievable source (C callables, REPL lambdas)
@@ -70,7 +71,7 @@ def _fn_suppressed_rules(fn: Callable) -> set:
     rules = set(sup.file_wide)
     for line_rules in sup.by_line.values():
         rules |= set(line_rules)
-    return {r for r in rules if r.startswith("RKT2")}
+    return {r for r in rules if r.startswith(prefix)}
 
 
 def _filter_suppressed(findings: list[Finding],

@@ -40,6 +40,17 @@ def _grouped_matmul(lhs, rhs, group_sizes):
     ``jax.lax.ragged_dot``'s XLA lowering ~1.4x slower — probe record in
     docs/performance.md). Elsewhere (CPU tests) ``ragged_dot`` — identical
     semantics, no Mosaic.
+
+    Accumulation is fp32 on both paths (RKT401: a grouped matmul chains
+    partial sums across tile/group boundaries, so a sub-fp32 accumulator
+    rounds between partials). The gmm kernel does this by construction —
+    an fp32 VMEM ``acc_scratch`` cast to the output dtype once at store —
+    so it keeps the operand-dtype output. The XLA ``ragged_dot`` lowering
+    has no such internal scratch, and its AD rule mishandles
+    ``preferred_element_type`` != operand dtype (fp32 cotangents meet
+    bf16 ones in ``add_jaxvals`` — verified on this jax), so fp32
+    accumulation goes in through WIDENED OPERANDS and the result is
+    downcast after; the operand casts keep the VJP dtypes consistent.
     """
     m, k = lhs.shape
     _, _, n = rhs.shape
@@ -50,8 +61,9 @@ def _grouped_matmul(lhs, rhs, group_sizes):
         tiling = (min(512, m), min(512, k), min(512, n))
         return gmm(lhs, rhs, group_sizes, lhs.dtype, tiling)
     return jax.lax.ragged_dot(
-        lhs, rhs, group_sizes, preferred_element_type=lhs.dtype
-    )
+        lhs.astype(jnp.float32), rhs.astype(jnp.float32), group_sizes,
+        preferred_element_type=jnp.float32,
+    ).astype(lhs.dtype)
 
 
 class MoE(Layer):
@@ -118,8 +130,14 @@ class MoE(Layer):
         e, k = self.num_experts, self.top_k
 
         # -- routing (f32 end-to-end: a bf16 router matmul flips near-tied
-        # experts; the Switch/GShard lineage mandates f32 here) ------------
+        # experts; the Switch/GShard lineage mandates f32 here). The
+        # deliberate widening of x marks this as an fp32 island for the
+        # precision auditor (RKT405 exempts widened-activation matmuls);
+        # the assert pins the convention against future edits. ------------
         logits = x.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
+        assert logits.dtype == jnp.float32, (
+            "MoE router logits must stay fp32 end-to-end"
+        )
         gates = jax.nn.softmax(logits, axis=-1)  # (B, T, E)
         top_gates, top_idx = jax.lax.top_k(gates, k)  # (B, T, K)
         top_gates = top_gates / jnp.maximum(
@@ -183,11 +201,21 @@ class MoE(Layer):
             expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
 
         # -- expert computation (E leading; shard E over 'expert' — GSPMD
-        # lowers the einsum-mode dispatch/combine to all-to-alls) ---------
+        # lowers the einsum-mode dispatch/combine to all-to-alls). The
+        # expert matmuls accumulate fp32 (RKT401) and downcast after; the
+        # dispatch/combine einsums stay in the compute dtype — their
+        # one-hot contractions touch at most one (dispatch) / top_k
+        # (combine) nonzero per output, so nothing accumulates. ----------
         ex = p["experts"]
-        h = jnp.einsum("ebcd,edh->ebch", expert_in, ex["w_in"].astype(x.dtype))
+        h = jnp.einsum(
+            "ebcd,edh->ebch", expert_in, ex["w_in"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
         h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[:, None, None, :])
-        out = jnp.einsum("ebch,ehd->ebcd", h, ex["w_out"].astype(x.dtype))
+        out = jnp.einsum(
+            "ebch,ehd->ebcd", h, ex["w_out"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
         out = out + ex["b_out"].astype(x.dtype)[:, None, None, :]
 
         if self.dispatch == "scatter":

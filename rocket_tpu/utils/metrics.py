@@ -133,7 +133,7 @@ class Perplexity(Metric):
     def _nll_sum(self, logits, tokens, real_size, xp):
         import optax
 
-        lp = logits[:, :-1].astype("float32")
+        lp = logits[:, :-1].astype(xp.float32)
         tgt = tokens[:, 1:]
         nll = optax.softmax_cross_entropy_with_integer_labels(lp, tgt)
         valid = (xp.arange(tokens.shape[0]) < real_size)[:, None]

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
-# SPMD shard audit (self-gate + budget diff) + the tier-1 test suite
+# SPMD shard audit (self-gate + budget diff) + precision audit
+# (dtype-flow self-gate + numerics budgets) + the tier-1 test suite
 # (command from ROADMAP.md). Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -20,6 +21,13 @@ echo "== shard audit (SPMD self-gate + budgets) =="
 # collective-bytes / HBM regression over tests/fixtures/budgets/.
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis shard \
     --budgets tests/fixtures/budgets
+
+echo "== precision audit (dtype-flow self-gate + numerics budgets) =="
+# Walks the traced train/eval steps; fails on mixed-precision findings
+# (RKT401-405) or a >10% fp32-bytes-fraction / cast-count regression
+# over tests/fixtures/budgets/prec/.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis prec \
+    --budgets tests/fixtures/budgets/prec
 
 echo "== tier-1 tests =="
 set -o pipefail

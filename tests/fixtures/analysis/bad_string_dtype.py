@@ -1,0 +1,27 @@
+"""True positives for RKT108: string-literal dtypes in casts."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_logits(logits):
+    return np.asarray(logits).astype("float32")  # RKT108
+
+
+def upcast_loss(nll):
+    return nll.astype("float64").sum()  # RKT108
+
+
+def narrow_activations(x):
+    return x.astype("bfloat16")  # RKT108
+
+
+def keyword_form(x):
+    return x.astype(dtype="float32")  # RKT108 — keyword spelling too
+
+
+def dynamic_name(x):
+    # A COMPUTED string is still a string dtype at runtime but not a
+    # literal — out of scope for a syntactic rule (and rare enough that
+    # the literal form is the one worth policing).
+    return x.astype(jnp.float32)

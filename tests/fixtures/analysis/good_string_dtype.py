@@ -1,0 +1,22 @@
+"""Clean negatives for RKT108: canonical dtype objects in casts."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_logits(logits):
+    return np.asarray(logits).astype(np.float32)
+
+
+def upcast_loss(nll):
+    return nll.astype(jnp.float32).sum()
+
+
+def narrow_activations(x, compute_dtype=jnp.bfloat16):
+    return x.astype(compute_dtype)
+
+
+def match_peer(x, y):
+    # Casting to another array's dtype is the cast-at-use convention
+    # itself — never a string.
+    return x.astype(y.dtype)

@@ -43,6 +43,7 @@ AST_CASES = [
     ("RKT105", "handler_signature"),
     ("RKT106", "launch_host_sync"),
     ("RKT107", "fork_start_method"),
+    ("RKT108", "string_dtype"),
 ]
 
 

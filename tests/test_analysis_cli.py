@@ -1,12 +1,12 @@
-"""The analysis CLI process contract, for both entry forms.
+"""The analysis CLI process contract, for all three entry forms.
 
-``python -m rocket_tpu.analysis`` (rocketlint over paths) and
-``python -m rocket_tpu.analysis shard`` (the SPMD auditor) must hold the
-same machine contract CI scripts depend on: exit 0 on a clean tree, 1 on
-findings, 2 on usage errors, and one ``--format json`` output shape.
-Everything runs as a real subprocess under ``JAX_PLATFORMS=cpu`` — the
-shard subcommand provisions its own fake 8-device mesh, so no test
-fixture leaks into the contract.
+``python -m rocket_tpu.analysis`` (rocketlint over paths),
+``... shard`` (the SPMD auditor) and ``... prec`` (the dtype-flow
+auditor) must hold the same machine contract CI scripts depend on: exit
+0 on a clean tree, 1 on findings, 2 on usage errors, and one
+``--format json`` output shape. Everything runs as a real subprocess
+under ``JAX_PLATFORMS=cpu`` — the audit subcommands provision their own
+fake 8-device backend, so no test fixture leaks into the contract.
 """
 
 import json
@@ -54,10 +54,11 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_three_families():
+def test_list_rules_includes_all_four_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
-    for rule_id in ("RKT101", "RKT201", "RKT301", "RKT305", "RKT306"):
+    for rule_id in ("RKT101", "RKT108", "RKT201", "RKT301", "RKT306",
+                    "RKT401", "RKT406"):
         assert rule_id in proc.stdout
 
 
@@ -107,6 +108,68 @@ def test_shard_badrules_reports_dead_replicated_excess():
     assert proc.returncode == 1
     rules = {f["rule"] for f in json.loads(proc.stdout)}
     assert {"RKT301", "RKT304", "RKT305"} <= rules
+
+
+# -- prec form ---------------------------------------------------------------
+
+PREC_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "prec")
+
+
+def test_prec_usage_errors_exit_two():
+    assert run_cli("prec", "--target", "nope").returncode == 2
+    assert run_cli("prec", "--update-budgets").returncode == 2  # no --budgets
+
+
+def test_prec_list_targets():
+    proc = run_cli("prec", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "tp_2x4_eval", "badprec"):
+        assert name in proc.stdout
+
+
+def test_prec_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: the repo's own bf16 train/eval steps under
+    the committed numerics budgets — zero findings, exit 0."""
+    proc = run_cli("prec", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets", "prec"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_prec_badprec_reports_all_five_rules():
+    """True positives through the real CLI: the seeded-bad step must
+    surface every RKT40x family, exit 1, in the shared JSON shape."""
+    proc = run_cli("prec", "--target", "badprec", "--format", "json")
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert set(findings[0]) == {"rule", "path", "line", "message"}
+    rules = {f["rule"] for f in findings}
+    assert rules == {"RKT401", "RKT402", "RKT403", "RKT404", "RKT405"}
+
+
+@pytest.mark.slow
+def test_prec_budget_regression_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: shrink the committed fp32-bytes fraction (equivalently
+    the measured fraction grew) -> RKT406, exit 1; --update-budgets
+    re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "prec"
+    budgets_dir.mkdir()
+    committed = json.load(open(os.path.join(PREC_BUDGETS, "tp_2x4.json")))
+    committed["fp32_bytes_fraction"] = committed["fp32_bytes_fraction"] * 0.5
+    (budgets_dir / "tp_2x4.json").write_text(json.dumps(committed))
+
+    proc = run_cli("prec", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT406" in proc.stdout
+    assert "fp32_bytes_fraction" in proc.stdout
+
+    proc = run_cli("prec", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+
+    proc = run_cli("prec", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 @pytest.mark.slow

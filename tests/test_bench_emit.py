@@ -162,6 +162,37 @@ def test_write_detail_carries_shard_audit_record(tmp_path):
     assert target["hbm_per_device_bytes"] > 0
 
 
+def test_write_detail_carries_prec_audit_record(tmp_path):
+    """BENCH_DETAIL.json carries the statically-audited numerics (fp32-
+    bytes fraction of the traced step, widen/narrow cast counts) from
+    the committed numerics budgets the precision CI gate verifies."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    audit = json.loads(path.read_text())["prec_audit"]
+    assert 0.0 < audit["fp32_bytes_fraction"] < 1.0
+    assert audit["narrow_casts"] > 0
+    assert audit["source"] == "tests/fixtures/budgets/prec"
+    # Per-target breakdown: every committed numerics budget shows up.
+    assert "tp_2x4" in audit["targets"]
+    target = audit["targets"]["tp_2x4"]
+    assert 0.0 < target["fp32_bytes_fraction"] < 1.0
+    assert target["widen_casts"] > 0
+
+
+def test_prec_audit_summary_missing_budgets_is_none(tmp_path):
+    """A checkout without committed numerics budgets must not break
+    emission."""
+    assert bench.prec_audit_summary(str(tmp_path / "nowhere")) is None
+    path = tmp_path / "BENCH_DETAIL.json"
+    real = bench.PREC_BUDGETS_DIR
+    bench.PREC_BUDGETS_DIR = str(tmp_path / "nowhere")
+    try:
+        bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    finally:
+        bench.PREC_BUDGETS_DIR = real
+    assert "prec_audit" not in json.loads(path.read_text())
+
+
 def test_shard_audit_summary_missing_budgets_is_none(tmp_path):
     """A checkout without committed budgets must not break emission."""
     assert bench.shard_audit_summary(str(tmp_path / "nowhere")) is None

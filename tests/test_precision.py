@@ -1,0 +1,167 @@
+"""The fp32-master / cast-at-use convention (``nn/layers.py`` module
+docstring), machine-checked: params initialize and stay fp32, a bf16
+forward returns bf16, gradients arrive fp32 at the master params, and
+the MoE numerics fixes hold (fp32 expert-matmul accumulation, fp32
+router end-to-end) — asserted through the precision auditor's fact
+stream where a dtype alone can't prove where the accumulation happened.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocket_tpu.analysis.prec_audit import audit_precision, collect_dtype_flow
+from rocket_tpu.nn.layers import (
+    AvgPool2D,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    RMSNorm,
+)
+from rocket_tpu.nn.moe import MoE
+
+LAYER_CASES = [
+    ("dense", lambda: Dense(16, 32), (4, 16)),
+    ("conv", lambda: Conv2D(3, 8, kernel_size=3), (2, 8, 8, 3)),
+    ("layernorm", lambda: LayerNorm(16), (4, 16)),
+    ("rmsnorm", lambda: RMSNorm(16), (4, 16)),
+    ("batchnorm", lambda: BatchNorm(16), (4, 16)),
+]
+
+
+def float_leaves(tree):
+    return [
+        (path, leaf) for path, leaf in
+        jax.tree_util.tree_flatten_with_path(tree)[0]
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+
+
+@pytest.mark.parametrize("name,build,shape",
+                         [c for c in LAYER_CASES], ids=[c[0] for c in LAYER_CASES])
+def test_params_master_fp32_outputs_match_x_dtype(name, build, shape):
+    layer = build()
+    variables = layer.init(jax.random.key(0))
+    for path, leaf in float_leaves(variables):
+        assert leaf.dtype == jnp.float32, (name, path, leaf.dtype)
+
+    x = jax.random.normal(jax.random.key(1), shape, jnp.bfloat16)
+    y, state = layer.apply(variables, x, mode="train")
+    assert y.dtype == jnp.bfloat16, (name, y.dtype)
+    # Running statistics (BatchNorm) stay fp32 masters too.
+    for path, leaf in float_leaves(state):
+        assert jnp.asarray(leaf).dtype == jnp.float32, (name, path)
+
+
+@pytest.mark.parametrize("name,build,shape",
+                         [c for c in LAYER_CASES], ids=[c[0] for c in LAYER_CASES])
+def test_gradients_arrive_fp32_at_master_params(name, build, shape):
+    """Cast-at-use backward: d(astype)/dp upcasts the cotangent, so the
+    grads land in the master dtype and the optimizer update never mixes."""
+    layer = build()
+    variables = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), shape, jnp.bfloat16)
+
+    def loss(params):
+        y, _ = layer.apply(
+            {"params": params, "state": variables["state"]}, x, mode="train"
+        )
+        return jnp.sum(y.astype(jnp.float32))
+
+    grads = jax.grad(loss)(variables["params"])
+    for path, leaf in float_leaves(grads):
+        assert leaf.dtype == jnp.float32, (name, path, leaf.dtype)
+
+
+def test_pool_dropout_embedding_dtypes():
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 4), jnp.bfloat16)
+    y, _ = AvgPool2D(2).apply({"params": {}, "state": {}}, x)
+    assert y.dtype == jnp.bfloat16
+    y, _ = Dropout(0.5).apply(
+        {"params": {}, "state": {}}, x, rng=jax.random.key(1)
+    )
+    assert y.dtype == jnp.bfloat16
+    # Embedding gathers stay fp32 — the model casts AFTER the positional
+    # add (transformer.py activation_dtype), so the table keeps a single
+    # master copy and the sum of two fp32 tables doesn't round twice.
+    emb = Embedding(16, 8)
+    variables = emb.init(jax.random.key(2))
+    out, _ = emb.apply(variables, jnp.zeros((2, 3), jnp.int32))
+    assert out.dtype == jnp.float32
+
+
+# -- MoE numerics (the RKT401/RKT402 fixes) ----------------------------------
+
+
+def moe_flow(dispatch="einsum", dtype=jnp.bfloat16):
+    moe = MoE(dim=64, hidden=128, num_experts=4, top_k=2, dispatch=dispatch)
+    params = jax.eval_shape(moe.init_params, jax.random.key(0))
+    variables = {"params": params, "state": {}}
+    batch = {"x": jax.ShapeDtypeStruct((2, 16, 64), dtype)}
+
+    def step(variables, batch):
+        y, aux = moe.apply(variables, batch["x"])
+        return y, aux
+
+    return collect_dtype_flow(step, variables, batch,
+                              compute_dtype=dtype) + (step, variables, batch)
+
+
+@pytest.mark.parametrize("dispatch", ["einsum", "scatter", "dropless"])
+def test_expert_matmuls_accumulate_fp32(dispatch):
+    flow, _in, _out, *_rest = moe_flow(dispatch)
+    expert_dots = [
+        d for d in flow.dots
+        if d.param_path and d.param_path[-1] in ("w_in", "w_out")
+    ]
+    assert expert_dots, f"no expert matmuls seen for {dispatch}"
+    for dot in expert_dots:
+        assert np.dtype(dot.acc_dtype) == np.dtype(jnp.float32), (
+            dispatch, dot
+        )
+
+
+def test_router_logits_stay_fp32_end_to_end():
+    flow, *_rest = moe_flow("einsum")
+    router_dots = [
+        d for d in flow.dots
+        if d.param_path and "router" in d.param_path
+    ]
+    assert router_dots
+    for dot in router_dots:
+        assert np.dtype(dot.acc_dtype) == np.dtype(jnp.float32)
+    # The softmax over router logits runs fp32: every traced exp is f32.
+    for fact in flow.trans:
+        if fact.prim in ("exp", "exp2"):
+            assert np.dtype(fact.dtype) == np.dtype(jnp.float32), fact
+
+
+@pytest.mark.parametrize("dispatch", ["einsum", "scatter", "dropless"])
+def test_moe_is_clean_under_the_precision_auditor(dispatch):
+    *_flow, step, variables, batch = moe_flow(dispatch)
+    report = audit_precision(
+        step, variables, batch, compute_dtype=jnp.bfloat16,
+        check_state=False,
+    )
+    assert report.findings == [], [f.render() for f in report.findings]
+
+
+def test_moe_bf16_forward_matches_fp32_reference():
+    """The fp32-accumulation fix must keep the bf16 path numerically
+    close to the all-fp32 reference (it can only get closer)."""
+    moe = MoE(dim=32, hidden=64, num_experts=4, top_k=2,
+              capacity_factor=4.0)
+    params = moe.init_params(jax.random.key(0))
+    x32 = jax.random.normal(jax.random.key(1), (2, 8, 32), jnp.float32)
+    y32, _ = moe.apply({"params": params, "state": {}}, x32)
+    y16, _ = moe.apply(
+        {"params": params, "state": {}}, x32.astype(jnp.bfloat16)
+    )
+    assert y16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), rtol=0.1, atol=0.05
+    )
