@@ -660,6 +660,63 @@ def prec_audit_summary(budgets_dir=PREC_BUDGETS_DIR):
     )
 
 
+#: Where a telemetry-enabled bench run's record lands: bench trees carry
+#: no Tracker, so Runtime.end_training falls back to
+#: <project_dir>/runs/telemetry with project_dir "." — i.e. relative to
+#: the CWD bench ran from, not to this file. The repo-rooted path is the
+#: second candidate for the usual run-from-repo-root case.
+TELEMETRY_CANDIDATES = (
+    os.path.join("runs", "telemetry", "telemetry.json"),
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "runs", "telemetry", "telemetry.json"),
+)
+
+#: Freshness fence: only a telemetry.json written by THIS process run may
+#: enter BENCH_DETAIL.json — a leftover record from an earlier
+#: telemetry-enabled run must not masquerade as this run's goodput.
+_PROCESS_START = time.time()
+
+
+def telemetry_summary(path=None):
+    """Goodput + key run metrics from this run's telemetry record
+    (``ROCKET_TPU_TELEMETRY=1 python bench.py ...``; successive configs
+    overwrite, so this records the final config's phases). None when
+    telemetry was off, the record predates this process (stale file from
+    an earlier run), or it is unreadable — emission must never die on
+    observability."""
+    try:
+        if path is None:
+            path = next(
+                (p for p in TELEMETRY_CANDIDATES
+                 if os.path.exists(p)
+                 and os.path.getmtime(p) >= _PROCESS_START),
+                None,
+            )
+            if path is None:
+                return None
+        with open(path) as f:
+            record = json.load(f)
+        goodput = record["goodput"]
+        metrics = record.get("metrics", {})
+        out = {
+            "goodput_fraction": goodput.get("goodput_fraction"),
+            "total_wall_s": goodput.get("total_wall_s"),
+            "fractions": goodput.get("fractions"),
+            "source": os.path.relpath(path, os.path.dirname(DETAIL_PATH)),
+        }
+        gauges = metrics.get("gauges", {})
+        for key in ("perf/steps_per_sec", "perf/mfu",
+                    "hbm/peak_bytes_in_use_max"):
+            if key in gauges:
+                out[key] = gauges[key]
+        stalls = record.get("watchdog", {}).get("stalls")
+        if stalls:
+            out["watchdog_stalls"] = stalls
+        return out
+    except Exception:  # noqa: BLE001 — best-effort, like the audit summaries
+        return None
+
+
 def write_detail(results, path=DETAIL_PATH):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
@@ -703,6 +760,12 @@ def write_detail(results, path=DETAIL_PATH):
         # Statically-audited numerics next to the measured throughput:
         # fp32-bytes fraction of the traced step + cast counts per target.
         detail["prec_audit"] = prec
+    telemetry = telemetry_summary()
+    if telemetry is not None:
+        # Live-run goodput split (rocket_tpu.obs) from a telemetry-enabled
+        # bench run: measured compile/data-wait/step fractions next to the
+        # throughput they explain.
+        detail["telemetry"] = telemetry
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

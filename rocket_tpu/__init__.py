@@ -26,9 +26,10 @@ from rocket_tpu.core import (
     Tracker,
     register_tracker_backend,
 )
+from rocket_tpu import obs
 from rocket_tpu.runtime.context import Runtime
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "Attributes",
@@ -48,5 +49,6 @@ __all__ = [
     "Runtime",
     "Scheduler",
     "Tracker",
+    "obs",
     "register_tracker_backend",
 ]

@@ -126,13 +126,23 @@ class Capsule:
     # -- dispatch ----------------------------------------------------------
 
     def dispatch(self, event: Events, attrs: Attributes | None = None) -> None:
-        """Route an event to its handler method (``capsule.py:97-98``)."""
+        """Route an event to its handler method (``capsule.py:97-98``).
+
+        The 5-event protocol makes this THE choke point for host-side
+        observability: with run telemetry enabled (``rocket_tpu.obs``),
+        every dispatched event becomes one Chrome-trace span. Disabled
+        (default), the cost is a single attribute check."""
         if not isinstance(event, Events):
             raise RuntimeError(
                 f"{type(self).__name__}: dispatch expects an Events member, "
                 f"got {event!r}"
             )
-        getattr(self, event.value)(attrs)
+        telemetry = getattr(self._runtime, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            with telemetry.span(f"{type(self).__name__}.{event.value}"):
+                getattr(self, event.value)(attrs)
+        else:
+            getattr(self, event.value)(attrs)
 
     # -- runtime binding ---------------------------------------------------
 

@@ -182,6 +182,11 @@ class Checkpointer(Capsule):
                 f"Checkpointer: overwrite is set to False. {path}"
             )
 
+        with runtime.telemetry.span(f"checkpoint/save[{step}]",
+                                    cat="checkpoint"):
+            return self._save_sync(runtime, step, path)
+
+    def _save_sync(self, runtime, step: int, path: str) -> str:
         # Backpressure: at most one write in flight, and the previous step's
         # files are complete before this one starts (keep_last can prune
         # safely below).
@@ -236,9 +241,13 @@ class Checkpointer(Capsule):
     def destroy(self, attrs: Attributes | None = None) -> None:
         """Drain the async writer, then the usual teardown; the trailing
         barrier guarantees every host's shards exist before anyone resumes."""
-        self._writer.wait()
         if self._runtime is not None:
-            self._runtime.wait_for_everyone()
+            with self._runtime.telemetry.span("checkpoint/drain",
+                                              cat="checkpoint"):
+                self._writer.wait()
+                self._runtime.wait_for_everyone()
+        else:
+            self._writer.wait()
         super().destroy(attrs)
 
     # -- restore -----------------------------------------------------------
@@ -248,6 +257,10 @@ class Checkpointer(Capsule):
         if not os.path.isdir(path):
             raise RuntimeError(f"Checkpointer: resume_from {path!r} does not exist.")
 
+        with runtime.telemetry.span("checkpoint/load", cat="checkpoint"):
+            self._load_inner(runtime, path)
+
+    def _load_inner(self, runtime, path: str) -> None:
         for k, prepared in enumerate(runtime.models.values()):
             model_path = os.path.join(path, f"model_{k}")
             if os.path.isdir(model_path):

@@ -182,6 +182,7 @@ class Dataset(Capsule):
             seed=runtime.seed,
             process_index=runtime.process_index,
             process_count=runtime.process_count,
+            telemetry=runtime.telemetry,
             **self._loader_kwargs,
         )
 
@@ -232,7 +233,10 @@ class Dataset(Capsule):
             # below — device_puts issued from a worker interleave with the
             # queued steps, which stalls the transfer path (measured ~100x
             # on the tunneled TPU).
-            iterator = PrefetchIterator(iterator, depth=self._prefetch)
+            iterator = PrefetchIterator(
+                iterator, depth=self._prefetch,
+                telemetry=self._runtime.telemetry,
+            )
         self._iterator = iterator
 
     def launch(self, attrs: Attributes | None = None) -> None:
@@ -240,8 +244,14 @@ class Dataset(Capsule):
             return
         if attrs.batch is not None:
             return  # produce-if-absent (dataset.py:98-99)
+        # Telemetry: the time the loop blocks on the input pipeline (queue
+        # get / host read+collate) and the explicit H2D placement are the
+        # run's "data_wait" — the spans are host timers around calls the
+        # step path makes anyway.
+        telemetry = self._runtime.telemetry
         try:
-            batch: Batch = next(self._iterator)
+            with telemetry.span("data/next", cat="data_wait"):
+                batch: Batch = next(self._iterator)
         except StopIteration:
             if attrs.looper is not None:
                 attrs.looper.terminate = True  # dataset.py:104-109
@@ -249,7 +259,8 @@ class Dataset(Capsule):
 
         data = batch.data
         if self._device_placement and not self._device_resident:
-            data = self._runtime.shard_batch(data)  # dataset.py:111-118
+            with telemetry.span("data/h2d", cat="data_wait"):
+                data = self._runtime.shard_batch(data)  # dataset.py:111-118
         attrs.batch = data
         attrs.batch_info = Attributes(size=batch.size, index=batch.index)
         if attrs.looper is not None:

@@ -86,6 +86,9 @@ class Looper(Dispatcher):
         self._epoch_idx = 0
         self._batch_idx = 0  # mid-epoch position, persisted for resume
         self._active = True  # run_every gate for the current epoch
+        # First wave driven in THIS process (not checkpointed): that wave
+        # traces+compiles the step, so telemetry classifies it "compile".
+        self._warmed = False
 
     # -- properties --------------------------------------------------------
 
@@ -149,6 +152,18 @@ class Looper(Dispatcher):
 
         bar = self._progress_bar()
         start = self._batch_idx  # >0 only on mid-epoch resume
+
+        # Run telemetry (rocket_tpu.obs): each iteration wave gets a host
+        # span (category "compile" for the first wave this process drives —
+        # that wave traces+compiles the step — "step" after) paired with a
+        # jax.profiler.StepTraceAnnotation so a concurrent device trace
+        # shares the step boundaries, and the hang watchdog is armed for
+        # exactly the duration of the loop with a beat per completed wave.
+        # All of it is host bookkeeping — nothing touches the device.
+        telemetry = getattr(self._runtime, "telemetry", None)
+        obs_on = telemetry is not None and telemetry.enabled
+        if obs_on:
+            telemetry.watchdog_arm()
         try:
             for it in range(start, self._repeats):
                 attrs.batch = None
@@ -163,8 +178,23 @@ class Looper(Dispatcher):
                 # constants (an implicit H2D by design); from the second
                 # wave on the shapes are stable — wrap padding guarantees
                 # it — and everything implicit is a genuine leak.
+                step_span = (
+                    telemetry.step_span(
+                        self._tag, self._batch_idx,
+                        cat=("step" if self._warmed else "compile"),
+                    )
+                    if obs_on
+                    else None
+                )
                 with self._iteration_guard(warmup=(it == start)):
-                    Dispatcher.launch(self, attrs)
+                    if step_span is not None:
+                        with step_span:
+                            Dispatcher.launch(self, attrs)
+                    else:
+                        Dispatcher.launch(self, attrs)
+                self._warmed = True
+                if obs_on:
+                    telemetry.beat()
                 if attrs.looper is not None and attrs.looper.terminate:
                     break
                 self._batch_idx += 1
@@ -185,6 +215,8 @@ class Looper(Dispatcher):
                             refresh=False,
                         )
         finally:
+            if obs_on:
+                telemetry.watchdog_disarm()
             if bar is not None:
                 bar.close()
 

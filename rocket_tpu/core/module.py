@@ -192,6 +192,10 @@ class Module(Dispatcher):
         self._train_step = None
         self._eval_step = None
         self._host_step: Optional[int] = None
+        # Per-mode "first call done" flags: the first invocation of a jitted
+        # step blocks the host on trace+lower+compile, so telemetry wraps
+        # exactly that call in an explicit "compile" span.
+        self._stepped = {"train": False, "eval": False}
 
     # -- introspection helpers ---------------------------------------------
 
@@ -241,9 +245,13 @@ class Module(Dispatcher):
                 # block_until_ready: jax dispatch is async — an execution
                 # failure (OOM etc.) would otherwise escape this guard and
                 # surface later with a confusing traceback.
-                variables = jax.block_until_ready(
-                    jax.jit(self._model.init)(key)
-                )
+                with runtime.telemetry.span(
+                    f"compile/init[{type(self._model).__name__}]",
+                    cat="compile",
+                ):
+                    variables = jax.block_until_ready(
+                        jax.jit(self._model.init)(key)
+                    )
             except (TypeError, jax.errors.UnexpectedTracerError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError,
@@ -636,7 +644,17 @@ class Module(Dispatcher):
             # PreparedModule.host_step).
             if self._host_step is None:
                 self._host_step = int(self._prepared.host_step)
-            new_state, metrics = self._train_step(state, dynamic)
+            if not self._stepped["train"]:
+                # First call = trace+lower+compile on the host; the span is
+                # a host timer only (no device op, strict-guard safe).
+                with self._runtime.telemetry.span(
+                    f"compile/train_step[{type(self._model).__name__}]",
+                    cat="compile",
+                ):
+                    new_state, metrics = self._train_step(state, dynamic)
+                self._stepped["train"] = True
+            else:
+                new_state, metrics = self._train_step(state, dynamic)
             self._prepared.state = new_state
             self._host_step += 1
             self._prepared.host_step = self._host_step
@@ -677,7 +695,19 @@ class Module(Dispatcher):
                 eval_params = state["ema_params"]
             else:
                 eval_params = state["params"]
-            out = self._eval_step(eval_params, state["model_state"], dynamic)
+            if not self._stepped["eval"]:
+                with self._runtime.telemetry.span(
+                    f"compile/eval_step[{type(self._model).__name__}]",
+                    cat="compile",
+                ):
+                    out = self._eval_step(
+                        eval_params, state["model_state"], dynamic
+                    )
+                self._stepped["eval"] = True
+            else:
+                out = self._eval_step(
+                    eval_params, state["model_state"], dynamic
+                )
             # forward replaces batch (module.py:73)
             attrs.batch = _strip_marker(_merge_batch(out, static))
             attrs.step_metrics = None

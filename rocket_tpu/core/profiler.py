@@ -97,6 +97,14 @@ class Profiler(Capsule):
             n_dev = self._runtime.mesh.size if self._runtime is not None else 1
             mfu = flops * steps_per_sec / (self._peak * n_dev)
 
+        telemetry = getattr(self._runtime, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            # Host floats into the obs registry (gauge set = dict store):
+            # the same numbers the bar shows, queryable from telemetry.json.
+            telemetry.registry.gauge("perf/steps_per_sec").set(steps_per_sec)
+            if mfu is not None:
+                telemetry.registry.gauge("perf/mfu").set(mfu)
+
         if attrs is None:
             return
         if attrs.looper is not None and attrs.looper.state is not None:

@@ -192,6 +192,12 @@ class Tracker(Capsule):
                     )
                     backend = JsonlBackend(self._project, self._directory)
             runtime.init_tracker(self._backend_name, backend)
+            # Telemetry files default to the tracker's run directory
+            # (runs/<project>/telemetry.json) unless the Runtime was given
+            # an explicit telemetry_dir.
+            runtime.telemetry.suggest_out_dir(
+                os.path.join(self._directory, self._project)
+            )
             if self._config:
                 backend.log_scalars(
                     {f"config/{k}": v for k, v in self._config.items()
@@ -219,6 +225,25 @@ class Tracker(Capsule):
             attrs.tracker = None
         super().reset(attrs)
 
+    def destroy(self, attrs: Attributes | None = None) -> None:
+        """Drop the backend handle. The backend itself may be shared by
+        other Tracker capsules through the runtime registry, so the
+        actual ``close()`` belongs to runtime teardown
+        (``Runtime.end_training``) — a backend NOT registered there (a
+        non-main-process leftover, or a capsule driven without a
+        Launcher) is closed here so its file handle cannot outlive
+        DESTROY."""
+        backend, self._backend = self._backend, None
+        if backend is not None and self._runtime is not None:
+            if self._runtime.get_tracker(self._backend_name) is not backend:
+                close = getattr(backend, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception as exc:  # noqa: BLE001 — teardown path
+                        self.log_warning(f"backend close failed: {exc!r}")
+        super().destroy(attrs)
+
     # -- flush -------------------------------------------------------------
 
     def _flush(self, attrs: Attributes) -> None:
@@ -226,6 +251,15 @@ class Tracker(Capsule):
         images = attrs.tracker.images or {}
         if not scalars and not images:
             return
+        telemetry = getattr(self._runtime, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            with telemetry.span("tracker/flush", cat="flush"):
+                self._flush_inner(attrs, scalars, images, telemetry)
+        else:
+            self._flush_inner(attrs, scalars, images, None)
+
+    def _flush_inner(self, attrs: Attributes, scalars, images,
+                     telemetry) -> None:
         tag = None
         if attrs.looper is not None:
             tag = attrs.looper.tag
@@ -249,6 +283,17 @@ class Tracker(Capsule):
                     for k, v in host.items()
                 }
                 self._backend.log_images(host_images, self._iter_idx)
+            if telemetry is not None:
+                # Run telemetry snapshot rides every flush under obs/*:
+                # registry counters/gauges (HBM watermarks, compile
+                # events, queue depth, goodput fractions) — host floats,
+                # no device fetch beyond the explicit ones above.
+                obs_scalars = telemetry.scalars_snapshot()
+                if obs_scalars:
+                    self._backend.log_scalars(
+                        {f"obs/{k}": v for k, v in obs_scalars.items()},
+                        self._iter_idx,
+                    )
         # Reset buffers, bump the global step (tracker.py:114-117).
         attrs.tracker.scalars = Attributes()
         attrs.tracker.images = Attributes()

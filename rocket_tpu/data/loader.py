@@ -76,6 +76,7 @@ class DataLoader:
         process_count: int = 1,
         num_workers: int = 0,
         worker_start_method: Optional[str] = None,
+        telemetry=None,
     ) -> None:
         if batch_size < 1:
             raise ValueError(f"DataLoader: batch_size must be >= 1, got {batch_size}")
@@ -112,6 +113,13 @@ class DataLoader:
         # accepting the deadlock risk.
         self.num_workers = int(num_workers)
         self.worker_start_method = worker_start_method
+        # Optional rocket_tpu.obs.Telemetry (wired by the Dataset capsule):
+        # batches produced — split out for the worker-pool path — feed the
+        # metrics registry, so "how many batches came off which path" is a
+        # counter, not a log grep. Host-side increments only.
+        self._telemetry = telemetry if (
+            telemetry is not None and telemetry.enabled
+        ) else None
         if self.num_workers and not self._map_style:
             raise ValueError(
                 "DataLoader: num_workers requires a map-style dataset "
@@ -160,10 +168,25 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[Batch]:
         skip, self._skip = self._skip, 0
-        if self._map_style:
-            yield from self._iter_map_style(skip)
-        else:
-            yield from self._iter_iterable(skip)
+        iterator = (
+            self._iter_map_style(skip)
+            if self._map_style
+            else self._iter_iterable(skip)
+        )
+        if self._telemetry is None:
+            yield from iterator
+            return
+        produced = self._telemetry.registry.counter("data/batches_produced")
+        worker_batches = (
+            self._telemetry.registry.counter("data/worker_batches")
+            if self.num_workers
+            else None
+        )
+        for batch in iterator:
+            produced.inc()
+            if worker_batches is not None:
+                worker_batches.inc()
+            yield batch
 
     def _batch_host_indices(self, skip: int):
         """(host_idx, real, b) per batch — the single source of the epoch's
@@ -195,6 +218,7 @@ class DataLoader:
                     self.dataset, self.collate_fn, self.num_workers,
                     start_method=self.worker_start_method,
                     seed=self.seed,
+                    telemetry=self._telemetry,
                 )
             meta = []
 

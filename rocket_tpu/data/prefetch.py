@@ -42,6 +42,7 @@ class PrefetchIterator:
         iterable: Iterable[Any],
         depth: int = 2,
         transform: Optional[Callable[[Any], Any]] = None,
+        telemetry=None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"PrefetchIterator: depth must be >= 1, got {depth}")
@@ -49,6 +50,19 @@ class PrefetchIterator:
         self._transform = transform
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # Optional rocket_tpu.obs.Telemetry: the worker's produce time
+        # becomes spans on its own trace thread-line, and the queue depth
+        # observed at each dequeue feeds the metrics registry — the two
+        # numbers that separate "input-bound" from "chip-bound".
+        self._telemetry = telemetry if (
+            telemetry is not None and telemetry.enabled
+        ) else None
+        # Hoisted instrument handle: no registry lock/lookup per dequeue.
+        self._depth_hist = (
+            self._telemetry.registry.histogram("data/prefetch_depth", base=1.0)
+            if self._telemetry is not None
+            else None
+        )
         self._thread = threading.Thread(
             target=self._fill, name="rocket-tpu-prefetch", daemon=True
         )
@@ -56,14 +70,32 @@ class PrefetchIterator:
 
     def _fill(self) -> None:
         try:
-            for item in self._iterable:
-                if self._transform is not None:
-                    item = self._transform(item)
+            telemetry = self._telemetry
+            iterator = iter(self._iterable)
+            while True:
+                if telemetry is not None:
+                    # Span covers the real produce work (read + collate +
+                    # transform) on the worker's own trace thread-line.
+                    with telemetry.span("data/prefetch_produce"):
+                        item = self._produce(iterator)
+                else:
+                    item = self._produce(iterator)
+                if item is self._DONE:
+                    self._put(self._DONE)
+                    return
                 if not self._put(item):
                     return
-            self._put(self._DONE)
         except BaseException as e:  # re-raised on the consumer side
             self._put(e)
+
+    def _produce(self, iterator: Iterator[Any]) -> Any:
+        try:
+            item = next(iterator)
+        except StopIteration:
+            return self._DONE
+        if self._transform is not None:
+            item = self._transform(item)
+        return item
 
     def _put(self, item: Any) -> bool:
         """Blocking put that aborts when close() was requested."""
@@ -81,6 +113,10 @@ class PrefetchIterator:
     def __next__(self) -> Any:
         if self._stop.is_set():
             raise StopIteration
+        if self._depth_hist is not None:
+            # Depth seen by the consumer at each dequeue: persistently 0
+            # means the pipeline can't keep the chip fed.
+            self._depth_hist.observe(self._queue.qsize())
         item = self._queue.get()
         if item is self._DONE:
             self.close()

@@ -88,12 +88,27 @@ class WorkerPool:
     """
 
     def __init__(self, dataset, collate, num_workers: int,
-                 start_method: Optional[str] = None, seed: int = 0) -> None:
+                 start_method: Optional[str] = None, seed: int = 0,
+                 telemetry=None) -> None:
         if num_workers < 1:
             raise ValueError(
                 f"WorkerPool: num_workers must be >= 1, got {num_workers}"
             )
         self._num_workers = num_workers
+        # Optional rocket_tpu.obs.Telemetry: in-flight depth + the blocking
+        # result waits, observed on the CONSUMER side (the workers are
+        # separate processes). Spans carry no goodput category — this
+        # consumer usually runs on the prefetch thread, whose time overlaps
+        # the main loop's and must not inflate the run's phase totals.
+        self._telemetry = telemetry if (
+            telemetry is not None and telemetry.enabled
+        ) else None
+        # Hoisted instrument handle: no registry lock/lookup per batch.
+        self._inflight_hist = (
+            self._telemetry.registry.histogram("data/worker_inflight", base=1.0)
+            if self._telemetry is not None
+            else None
+        )
         # None -> forkserver/spawn (see module docstring): workers are
         # created without os.fork()-ing the multithreaded JAX parent, so
         # no lock held at fork time (logging handlers, user library
@@ -134,8 +149,15 @@ class WorkerPool:
                 futures.append(self._pool.submit(_load_batch, idx))
 
         top_up()
+        telemetry = self._telemetry
         while futures:
-            yield futures.popleft().result()
+            if telemetry is not None:
+                self._inflight_hist.observe(len(futures))
+                with telemetry.span("data/worker_wait"):
+                    result = futures.popleft().result()
+            else:
+                result = futures.popleft().result()
+            yield result
             top_up()
 
     def close(self) -> None:

@@ -1,0 +1,29 @@
+"""rocket_tpu.obs — run-wide telemetry: spans, goodput, metrics, watchdog.
+
+Enable per run with ``Runtime(telemetry=True)`` (or
+``ROCKET_TPU_TELEMETRY=1``); the runtime owns one :class:`Telemetry`
+object the whole capsule tree reports into, and writes
+``<runs dir>/telemetry.json`` plus a Perfetto-loadable
+``spans.trace.json`` at DESTROY. Render either with
+``python -m rocket_tpu.obs report <file>``. See docs/observability.md.
+"""
+
+from rocket_tpu.obs.goodput import CATEGORIES, Goodput, render_report
+from rocket_tpu.obs.registry import Counter, Gauge, Histogram, MetricsRegistry
+from rocket_tpu.obs.spans import SpanRecorder, load_chrome_trace
+from rocket_tpu.obs.telemetry import Telemetry
+from rocket_tpu.obs.watchdog import Watchdog
+
+__all__ = [
+    "CATEGORIES",
+    "Counter",
+    "Gauge",
+    "Goodput",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanRecorder",
+    "Telemetry",
+    "Watchdog",
+    "load_chrome_trace",
+    "render_report",
+]

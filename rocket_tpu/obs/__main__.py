@@ -1,0 +1,120 @@
+"""CLI: ``python -m rocket_tpu.obs report <telemetry.json | spans file>``.
+
+Renders a run's telemetry record as the goodput table plus the key
+registry metrics. Given a Chrome-trace span file instead, it validates
+the file and reconstructs per-category inclusive totals from the span
+events. Exit contract matches the analysis CLIs: 0 = rendered, 2 =
+usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from rocket_tpu.obs.goodput import CATEGORIES, render_report
+from rocket_tpu.obs.spans import load_chrome_trace
+
+
+def _report_telemetry(doc: dict) -> str:
+    lines = [render_report(doc.get("goodput", {}))]
+    metrics = doc.get("metrics", {})
+    scalars = dict(metrics.get("counters", {}))
+    scalars.update(metrics.get("gauges", {}))
+    if scalars:
+        lines.append("")
+        lines.append("metrics:")
+        for name in sorted(scalars):
+            lines.append(f"  {name:<36} {scalars[name]:g}")
+    for name, hist in sorted(metrics.get("histograms", {}).items()):
+        mean = hist.get("mean")
+        lines.append(
+            f"  {name:<36} count={hist.get('count', 0)}"
+            + (f" mean={mean:.4g}s" if mean is not None else "")
+        )
+    watchdog = doc.get("watchdog", {})
+    if watchdog.get("enabled"):
+        lines.append(
+            f"watchdog: deadline {watchdog.get('deadline_s')}s, "
+            f"{watchdog.get('stalls', 0)} stall(s)"
+        )
+    spans = doc.get("spans", {})
+    if spans:
+        lines.append(
+            f"spans: {spans.get('events', 0)} events "
+            f"({spans.get('dropped', 0)} dropped) in {spans.get('file')}"
+        )
+    return "\n".join(lines)
+
+
+def _report_spans(events: list[dict]) -> str:
+    """Per-category inclusive totals straight from a span file. (The
+    exclusive accounting lives in telemetry.json; this view answers
+    "what does the trace itself contain".)"""
+    totals: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    t_min, t_max = None, None
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        cat = event.get("cat", "span")
+        dur_s = float(event.get("dur", 0.0)) / 1e6
+        totals[cat] = totals.get(cat, 0.0) + dur_s
+        counts[cat] = counts.get(cat, 0) + 1
+        ts = float(event.get("ts", 0.0))
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = (
+            ts + float(event.get("dur", 0.0))
+            if t_max is None
+            else max(t_max, ts + float(event.get("dur", 0.0)))
+        )
+    span = 0.0 if t_min is None else (t_max - t_min) / 1e6
+    lines = [
+        f"span file: {sum(counts.values())} complete spans over {span:.3f}s",
+        f"{'category':<14} {'spans':>7} {'inclusive_s':>12}",
+    ]
+    ordered = [c for c in CATEGORIES if c in totals] + sorted(
+        c for c in totals if c not in CATEGORIES
+    )
+    for cat in ordered:
+        lines.append(f"{cat:<14} {counts[cat]:>7} {totals[cat]:>12.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.obs",
+        description="render a rocket_tpu telemetry record",
+    )
+    sub = parser.add_subparsers(dest="command")
+    report = sub.add_parser(
+        "report", help="render telemetry.json or a Chrome-trace span file"
+    )
+    report.add_argument("path", help="telemetry.json or spans.trace.json")
+    args = parser.parse_args(argv)
+    if args.command != "report":
+        parser.print_help()
+        return 2
+
+    try:
+        with open(args.path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
+        return 2
+
+    if isinstance(doc, dict) and "goodput" in doc:
+        print(_report_telemetry(doc))
+        return 0
+    try:
+        events = load_chrome_trace(args.path)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    print(_report_spans(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

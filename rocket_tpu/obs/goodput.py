@@ -1,0 +1,123 @@
+"""Goodput accounting — classify run wall-clock into exclusive phases.
+
+A run's wall-clock is split into the phases that matter operationally:
+
+* ``compile``    — first-step trace/lower/compile windows (and jitted init);
+* ``data_wait``  — the loop blocked on the input pipeline (queue get + H2D);
+* ``step``       — dispatching compiled steps (the *goodput* numerator);
+* ``checkpoint`` — save/restore, including async-writer drains;
+* ``flush``      — tracker materialization (the deliberate device syncs);
+* ``other``      — everything unattributed (setup, teardown, epoch gaps),
+  derived as ``total - sum(measured)`` so the categories always sum to the
+  run's wall-clock exactly.
+
+Accounting is **exclusive** (profiler self-time semantics): entering a
+nested category pauses the outer one, so a data wait inside a step wave
+charges ``data_wait``, not both. The stack is per-thread; totals merge
+under a lock. Like the span recorder, this is pure host arithmetic — no
+device ops anywhere near the step path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Goodput", "CATEGORIES", "render_report"]
+
+#: Phase names, in report order. "other" is derived, never charged directly.
+CATEGORIES = ("compile", "data_wait", "step", "checkpoint", "flush", "other")
+
+
+class Goodput:
+    """Exclusive per-category wall-clock accounting via a context stack."""
+
+    def __init__(self) -> None:
+        self._totals = {cat: 0.0 for cat in CATEGORIES if cat != "other"}
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _charge(self, cat: str, seconds: float) -> None:
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            self._totals[cat] = self._totals.get(cat, 0.0) + seconds
+
+    # -- stack accounting --------------------------------------------------
+
+    def push(self, cat: str, now: Optional[float] = None) -> None:
+        """Enter ``cat``: the enclosing category (if any) is charged up to
+        now and paused."""
+        now = time.perf_counter() if now is None else now
+        stack = self._stack()
+        if stack:
+            outer_cat, mark = stack[-1]
+            self._charge(outer_cat, now - mark)
+            stack[-1] = (outer_cat, now)
+        stack.append((cat, now))
+
+    def pop(self, now: Optional[float] = None) -> None:
+        """Leave the innermost category, charging it and resuming the outer."""
+        now = time.perf_counter() if now is None else now
+        stack = self._stack()
+        if not stack:
+            return
+        cat, mark = stack.pop()
+        self._charge(cat, now - mark)
+        if stack:
+            outer_cat, _ = stack[-1]
+            stack[-1] = (outer_cat, now)
+
+    # -- reporting ---------------------------------------------------------
+
+    def totals(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._totals)
+
+    def report(self, total_wall_s: float) -> dict:
+        """Per-phase seconds and fractions of ``total_wall_s``; ``other``
+        absorbs the unattributed remainder so the categories sum to the
+        total exactly."""
+        totals = self.totals()
+        measured = sum(totals.values())
+        total = max(float(total_wall_s), measured)
+        categories = {cat: round(totals.get(cat, 0.0), 6) for cat in CATEGORIES
+                      if cat != "other"}
+        categories["other"] = round(max(0.0, total - measured), 6)
+        fractions = {
+            cat: (seconds / total if total > 0 else 0.0)
+            for cat, seconds in categories.items()
+        }
+        return {
+            "total_wall_s": round(total, 6),
+            "categories": categories,
+            "fractions": {k: round(v, 6) for k, v in fractions.items()},
+            # THE headline: fraction of the run spent driving compiled steps.
+            "goodput_fraction": round(fractions.get("step", 0.0), 6),
+        }
+
+
+def render_report(report: dict) -> str:
+    """The goodput table, for the ``python -m rocket_tpu.obs report`` CLI."""
+    total = report.get("total_wall_s", 0.0)
+    lines = [
+        f"total wall-clock: {total:.3f}s   "
+        f"goodput (step fraction): {report.get('goodput_fraction', 0.0):.1%}",
+        f"{'phase':<12} {'seconds':>10} {'fraction':>9}",
+    ]
+    categories = report.get("categories", {})
+    fractions = report.get("fractions", {})
+    for cat in CATEGORIES:
+        if cat not in categories:
+            continue
+        lines.append(
+            f"{cat:<12} {categories[cat]:>10.3f} {fractions.get(cat, 0.0):>8.1%}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,191 @@
+"""Metrics registry — counters, gauges and histograms the run reports into.
+
+Host-side, lock-protected, dependency-free. Sources feeding it:
+
+* device HBM watermarks via ``device.memory_stats()`` (TPU/GPU backends;
+  CPU returns None and the gauges simply stay absent) — refreshed at
+  tracker-flush cadence, never per step;
+* XLA compile events via a ``jax.monitoring`` duration listener
+  (``/jax/core/compile/*``): count + histogram of backend-compile seconds,
+  catching the mid-run recompile the first-step span cannot see;
+* StrictMode's retrace and audited-collective counts
+  (``runtime/context.py``) and the prefetch queue depth
+  (``data/prefetch.py``).
+
+Snapshots land in every Tracker backend under ``obs/*`` at flush
+boundaries and in ``telemetry.json`` at DESTROY. All of it is plain
+Python arithmetic — a gauge set is a dict store, so instrumented code
+paths stay host-sync-free.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+
+
+class Counter:
+    """Monotonic count (events seen, batches produced, stalls fired)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, HBM bytes)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self) -> None:
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+
+class Histogram:
+    """Power-of-two bucketed distribution (durations, depths).
+
+    Buckets are ``2**k`` upper bounds over ``base`` — wide enough for
+    microseconds-to-minutes durations without configuration.
+    """
+
+    __slots__ = ("count", "total", "min", "max", "buckets", "base", "_lock")
+
+    def __init__(self, base: float = 1e-6) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.buckets: dict[int, int] = {}  # bucket exponent -> count
+        self.base = base
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            ratio = max(value, 0.0) / self.base
+            exponent = 0 if ratio <= 1.0 else math.ceil(math.log2(ratio))
+            self.buckets[exponent] = self.buckets.get(exponent, 0) + 1
+
+    @property
+    def mean(self) -> Optional[float]:
+        return None if self.count == 0 else self.total / self.count
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "buckets": {f"le_{self.base * 2 ** k:g}": n
+                        for k, n in sorted(self.buckets.items())},
+        }
+
+
+class MetricsRegistry:
+    """Create-once name -> instrument registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            instrument = self._counters.get(name)
+            if instrument is None:
+                instrument = self._counters[name] = Counter()
+            return instrument
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            instrument = self._gauges.get(name)
+            if instrument is None:
+                instrument = self._gauges[name] = Gauge()
+            return instrument
+
+    def histogram(self, name: str, base: float = 1e-6) -> Histogram:
+        with self._lock:
+            instrument = self._histograms.get(name)
+            if instrument is None:
+                instrument = self._histograms[name] = Histogram(base=base)
+            return instrument
+
+    # -- device / jax sources ---------------------------------------------
+
+    def record_device_memory(self) -> None:
+        """HBM watermarks across local devices. ``memory_stats()`` is a
+        host-side runtime query (no transfer, no sync); backends without
+        it (CPU) contribute nothing."""
+        import jax
+
+        in_use, peak = [], []
+        for device in jax.local_devices():
+            try:
+                stats = device.memory_stats()
+            except Exception:  # backend without memory introspection
+                stats = None
+            if not stats:
+                continue
+            if "bytes_in_use" in stats:
+                in_use.append(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                peak.append(stats["peak_bytes_in_use"])
+        if in_use:
+            self.gauge("hbm/bytes_in_use_max").set(max(in_use))
+        if peak:
+            self.gauge("hbm/peak_bytes_in_use_max").set(max(peak))
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Full structured dump (telemetry.json)."""
+        with self._lock:
+            counters = {name: c.value for name, c in self._counters.items()}
+            gauges = {name: g.value for name, g in self._gauges.items()
+                      if g.value is not None}
+            histograms = {name: h.snapshot()
+                          for name, h in self._histograms.items()}
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}
+
+    def scalars(self) -> dict[str, float]:
+        """Flat name -> float view for tracker backends: counters and
+        gauges verbatim, histograms as count/mean pairs."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for name, counter in self._counters.items():
+                out[name] = counter.value
+            for name, gauge in self._gauges.items():
+                if gauge.value is not None:
+                    out[name] = gauge.value
+            for name, histogram in self._histograms.items():
+                out[f"{name}/count"] = float(histogram.count)
+                if histogram.count:
+                    out[f"{name}/mean"] = histogram.total / histogram.count
+        return out

@@ -1,0 +1,153 @@
+"""Span recorder — host-side wall-clock spans in Chrome-trace format.
+
+The reference ships nothing beyond tqdm bars (SURVEY §5); this is the
+host half of run observability: every capsule event dispatch, data wait,
+checkpoint write, tracker flush and compile window becomes a completed
+("ph": "X") Chrome-trace event that Perfetto / chrome://tracing loads
+directly, with thread ids preserved so the prefetch worker's timeline
+sits next to the main loop's. ``jax.profiler.StepTraceAnnotation`` on the
+Looper's iterations (``core/loop.py``) gives the XLA device trace the
+same step boundaries, so a host span file and a ``jax.profiler`` trace of
+the same run line up.
+
+Everything here is host-side bookkeeping: two ``perf_counter`` reads and
+a list append per span, no device ops, no syncs — safe inside the strict
+transfer guard and the rocketlint step-path rules. A bounded buffer
+(``max_events``) keeps week-long runs from eating host RAM; drops are
+counted, never silent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["SpanRecorder", "load_chrome_trace"]
+
+
+class SpanRecorder:
+    """Collects completed spans and renders them as Chrome-trace JSON.
+
+    ``add`` appends a finished span; the *open*-span bookkeeping
+    (``push_open`` / ``pop_open``) exists for the watchdog: on a stall it
+    reads :meth:`open_spans` to report what every thread was inside when
+    the run stopped making progress.
+    """
+
+    def __init__(self, max_events: int = 200_000) -> None:
+        self.max_events = int(max_events)
+        self.t0 = time.perf_counter()
+        self._events: list[tuple] = []  # (name, cat, t_start, dur, tid)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        # tid -> stack of (name, cat, t_start) for live (unfinished) spans.
+        self._open: dict[int, list[tuple]] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def add(self, name: str, cat: Optional[str], t_start: float,
+            duration: float, tid: Optional[int] = None) -> None:
+        if tid is None:
+            tid = threading.get_ident()
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append((name, cat, t_start, duration, tid))
+
+    def push_open(self, name: str, cat: Optional[str], t_start: float) -> None:
+        tid = threading.get_ident()
+        stack = self._open.get(tid)
+        if stack is None:
+            with self._lock:
+                stack = self._open.setdefault(tid, [])
+        stack.append((name, cat, t_start))
+
+    def pop_open(self) -> None:
+        stack = self._open.get(threading.get_ident())
+        if stack:
+            stack.pop()
+
+    def open_spans(self) -> dict[int, list[str]]:
+        """Live span stack per thread id, innermost last (watchdog dump)."""
+        out = {}
+        for tid, stack in list(self._open.items()):
+            if stack:
+                out[tid] = [name for name, _cat, _t in list(stack)]
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self) -> list[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def category_totals(self) -> dict[str, float]:
+        """Inclusive seconds per category (overlap-unaware; the exclusive
+        accounting lives in :mod:`rocket_tpu.obs.goodput`)."""
+        totals: dict[str, float] = {}
+        for _name, cat, _t, dur, _tid in self.events():
+            if cat is not None:
+                totals[cat] = totals.get(cat, 0.0) + dur
+        return totals
+
+    # -- chrome trace ------------------------------------------------------
+
+    def to_chrome_trace(self) -> dict[str, Any]:
+        pid = os.getpid()
+        trace_events = []
+        thread_names = {t.ident: t.name for t in threading.enumerate()}
+        for name, cat, t_start, dur, tid in self.events():
+            trace_events.append({
+                "name": name,
+                "cat": cat or "span",
+                "ph": "X",
+                "ts": round((t_start - self.t0) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+            })
+        for tid, tname in thread_names.items():
+            if tid is None:
+                continue
+            trace_events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            })
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "rocket_tpu.obs", "dropped": self.dropped},
+        }
+
+    def write(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+def load_chrome_trace(path: str) -> list[dict]:
+    """Load and structurally validate a Chrome-trace JSON file; returns the
+    event list. Accepts both the object form (``{"traceEvents": [...]}``,
+    what :meth:`SpanRecorder.write` emits) and the bare-array form."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome-trace file (no event list)")
+    for event in events:
+        if not isinstance(event, dict) or "ph" not in event:
+            raise ValueError(f"{path}: malformed trace event {event!r}")
+    return events

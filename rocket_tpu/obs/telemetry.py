@@ -1,0 +1,282 @@
+"""Telemetry — the one per-runtime owner of spans, goodput, metrics, watchdog.
+
+The Runtime constructs exactly one :class:`Telemetry`
+(``Runtime(telemetry=True)`` or ``ROCKET_TPU_TELEMETRY=1``) and every
+instrumented layer reaches it through ``runtime.telemetry``:
+
+* ``Capsule.dispatch`` wraps each event dispatch in a span (the 5-event
+  protocol makes that one choke point for the whole tree);
+* the Looper wraps iteration waves in ``step``/``compile`` spans plus a
+  ``jax.profiler.StepTraceAnnotation`` and beats the watchdog;
+* Dataset/PrefetchIterator account data waits, Checkpointer accounts
+  saves, the Tracker accounts flushes and snapshots the registry.
+
+Disabled (the default) it is inert: ``span()`` hands back a shared
+no-op context and nothing else runs — the step path pays one attribute
+check. Enabled, all bookkeeping is host-side arithmetic; the files
+(``telemetry.json`` + ``spans.trace.json``) are written once, at
+DESTROY, by ``Runtime.end_training``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional
+
+from rocket_tpu.obs.goodput import CATEGORIES, Goodput
+from rocket_tpu.obs.registry import MetricsRegistry
+from rocket_tpu.obs.spans import SpanRecorder
+from rocket_tpu.obs.watchdog import Watchdog
+
+__all__ = ["Telemetry"]
+
+_GOODPUT_CATEGORIES = frozenset(cat for cat in CATEGORIES if cat != "other")
+
+#: jax.monitoring duration events counted as compile work.
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+
+
+class _Span:
+    """One span: trace event + open-stack entry + (categorized) goodput."""
+
+    __slots__ = ("_telemetry", "_name", "_cat", "_t0")
+
+    def __init__(self, telemetry: "Telemetry", name: str,
+                 cat: Optional[str]) -> None:
+        self._telemetry = telemetry
+        self._name = name
+        self._cat = cat
+
+    def __enter__(self) -> "_Span":
+        tel = self._telemetry
+        self._t0 = time.perf_counter()
+        tel.spans.push_open(self._name, self._cat, self._t0)
+        if self._cat in _GOODPUT_CATEGORIES:
+            tel.goodput.push(self._cat, self._t0)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        tel = self._telemetry
+        now = time.perf_counter()
+        if self._cat in _GOODPUT_CATEGORIES:
+            tel.goodput.pop(now)
+        tel.spans.pop_open()
+        tel.spans.add(self._name, self._cat, self._t0, now - self._t0)
+
+
+class Telemetry:
+    """Owns the span recorder, goodput accountant, metrics registry and
+    (optionally) the hang watchdog for one run."""
+
+    TELEMETRY_FILE = "telemetry.json"
+    SPANS_FILE = "spans.trace.json"
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        out_dir: Optional[str] = None,
+        watchdog_secs: Optional[float] = None,
+        max_span_events: int = 200_000,
+        logger=None,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.out_dir = out_dir  # explicit > tracker-suggested > runtime default
+        self._suggested_dir: Optional[str] = None
+        self._logger = logger
+        self.spans = SpanRecorder(max_events=max_span_events)
+        self.goodput = Goodput()
+        self.registry = MetricsRegistry()
+        self.watchdog: Optional[Watchdog] = None
+        if self.enabled and watchdog_secs is not None:
+            self.watchdog = Watchdog(
+                watchdog_secs,
+                on_stall=self._on_stall,
+                spans=self.spans,
+                registry=self.registry,
+                logger=logger,
+            )
+        self._t0 = time.perf_counter()
+        self._monitoring_listener = None
+        self._stall_reports: list[str] = []
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin the run clock, the compile-event listener and the
+        watchdog thread. No-op when disabled."""
+        if not self.enabled:
+            return
+        self._t0 = time.perf_counter()
+        self.spans.t0 = self._t0
+        self._register_compile_listener()
+        if self.watchdog is not None:
+            self.watchdog.start()
+
+    def _register_compile_listener(self) -> None:
+        if self._monitoring_listener is not None:
+            return
+        try:
+            import jax.monitoring as monitoring
+
+            registry = self.registry
+
+            def on_duration(event, duration, **kwargs):
+                if event.startswith(_COMPILE_EVENT_PREFIX):
+                    registry.counter("compile/events").inc()
+                    registry.histogram("compile/secs", base=1e-3).observe(
+                        duration
+                    )
+
+            monitoring.register_event_duration_secs_listener(on_duration)
+            self._monitoring_listener = on_duration
+        except Exception:  # jax.monitoring moved — telemetry stays partial
+            self._monitoring_listener = None
+
+    def _unregister_compile_listener(self) -> None:
+        listener, self._monitoring_listener = self._monitoring_listener, None
+        if listener is None:
+            return
+        try:
+            from jax._src import monitoring as monitoring_impl
+
+            monitoring_impl._unregister_event_duration_listener_by_callback(
+                listener
+            )
+        except Exception:  # private API moved — a stale listener is harmless
+            pass
+
+    # -- spans -------------------------------------------------------------
+
+    _NULL = contextlib.nullcontext()
+
+    def span(self, name: str, cat: Optional[str] = None):
+        """Context manager recording one host span; goodput-categorized
+        when ``cat`` names a phase. A shared no-op when disabled."""
+        if not self.enabled:
+            return self._NULL
+        return _Span(self, name, cat)
+
+    def step_span(self, tag: str, step_num: int, cat: str = "step"):
+        """One Looper iteration wave: host span + XLA StepTraceAnnotation
+        (so a concurrent ``jax.profiler`` device trace shares the step
+        boundaries)."""
+        if not self.enabled:
+            return self._NULL
+        import jax
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.span(f"{tag}/step", cat=cat))
+        stack.enter_context(
+            jax.profiler.StepTraceAnnotation(tag, step_num=step_num)
+        )
+        return stack
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def watchdog_arm(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.arm()
+
+    def watchdog_disarm(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+
+    def beat(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def _on_stall(self, report: str) -> None:
+        # Keep a bounded tail for telemetry.json + the stall dump file.
+        self._stall_reports.append(report)
+        del self._stall_reports[:-5]
+
+    # -- snapshots ---------------------------------------------------------
+
+    def suggest_out_dir(self, path: str) -> None:
+        """Tracker-informed default (``runs/<project>``); an explicit
+        ``out_dir`` always wins, first suggestion sticks."""
+        if self._suggested_dir is None:
+            self._suggested_dir = path
+
+    def scalars_snapshot(self) -> dict[str, float]:
+        """Flat registry view for tracker backends (``obs/*``), with the
+        HBM watermarks and goodput fractions refreshed. Host-only."""
+        if not self.enabled:
+            return {}
+        self.registry.record_device_memory()
+        report = self.goodput.report(time.perf_counter() - self._t0)
+        for cat, fraction in report["fractions"].items():
+            self.registry.gauge(f"goodput/{cat}_fraction").set(fraction)
+        return self.registry.scalars()
+
+    def summary(self) -> dict:
+        """The telemetry.json payload."""
+        total = time.perf_counter() - self._t0
+        self.registry.record_device_memory()
+        summary = {
+            "version": 1,
+            "goodput": self.goodput.report(total),
+            "metrics": self.registry.snapshot(),
+            "spans": {
+                "file": self.SPANS_FILE,
+                "events": len(self.spans),
+                "dropped": self.spans.dropped,
+            },
+            "watchdog": {
+                "enabled": self.watchdog is not None,
+                "deadline_s": (
+                    self.watchdog.deadline_s if self.watchdog else None
+                ),
+                "stalls": self.watchdog.stall_count if self.watchdog else 0,
+            },
+        }
+        return summary
+
+    # -- flush / close -----------------------------------------------------
+
+    def resolve_out_dir(self, default_dir: Optional[str] = None) -> str:
+        return self.out_dir or self._suggested_dir or default_dir or os.path.join(
+            "runs", "telemetry"
+        )
+
+    def flush(self, default_dir: Optional[str] = None) -> Optional[str]:
+        """Write ``telemetry.json`` + the span file; returns the directory
+        (None when disabled)."""
+        if not self.enabled:
+            return None
+        out_dir = self.resolve_out_dir(default_dir)
+        os.makedirs(out_dir, exist_ok=True)
+        self.spans.write(os.path.join(out_dir, self.SPANS_FILE))
+        payload = self.summary()
+        if self._stall_reports:
+            stall_path = os.path.join(out_dir, "watchdog_stalls.txt")
+            with open(stall_path, "w", encoding="utf-8") as f:
+                f.write("\n\n".join(self._stall_reports) + "\n")
+            payload["watchdog"]["report_file"] = "watchdog_stalls.txt"
+        tmp = os.path.join(out_dir, self.TELEMETRY_FILE + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, os.path.join(out_dir, self.TELEMETRY_FILE))
+        if self._logger is not None:
+            self._logger.info(
+                "telemetry: wrote %s", os.path.join(out_dir, self.TELEMETRY_FILE)
+            )
+        return out_dir
+
+    def close(self, default_dir: Optional[str] = None,
+              write: bool = True) -> None:
+        """Final flush + teardown (idempotent); ``write=False`` on
+        non-main processes skips the files but still stops the threads."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.enabled and write:
+            self.flush(default_dir)
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self._unregister_compile_listener()

@@ -1,0 +1,154 @@
+"""Hang watchdog — dump diagnostics when the step loop stops beating.
+
+A hung collective (one host down in a multi-host ring), a deadlocked
+data worker, or an XLA compile that never returns all look identical
+from outside: the progress bar freezes and the job eventually dies with
+nothing on stderr. This daemon thread watches a heartbeat the Looper
+beats after every completed iteration wave; when no beat lands within
+``deadline_s`` it dumps, while the process is still alive:
+
+* every Python thread's stack (``sys._current_frames``);
+* the live span stack per thread (what each thread was *inside*,
+  from :class:`~rocket_tpu.obs.spans.SpanRecorder`);
+* the live-array byte total (``jax.live_arrays()`` metadata — host-side,
+  no transfers).
+
+The dump is diagnostic, not fatal: the run keeps going (a slow step
+recovers; a true hang dies with its cause on record). The watchdog is
+armed only while a Looper is actually iterating, so a long setup or an
+inter-epoch eval pass cannot false-positive. Stalls are counted in the
+metrics registry and the report lands in the log, on the ``on_stall``
+callback, and (via Telemetry) next to ``telemetry.json``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+__all__ = ["Watchdog"]
+
+
+class Watchdog:
+    def __init__(
+        self,
+        deadline_s: float,
+        on_stall: Optional[Callable[[str], None]] = None,
+        spans=None,
+        registry=None,
+        logger=None,
+        poll_s: Optional[float] = None,
+    ) -> None:
+        if deadline_s <= 0:
+            raise ValueError(f"Watchdog: deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self._on_stall = on_stall
+        self._spans = spans
+        self._registry = registry
+        self._logger = logger
+        self._poll_s = poll_s if poll_s is not None else min(
+            1.0, self.deadline_s / 4.0
+        )
+        self._armed = False
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+        self.last_report: Optional[str] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rocket-tpu-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def arm(self) -> None:
+        self._last_beat = time.monotonic()
+        self._armed = True
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    # -- the watcher thread ------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if not self._armed:
+                continue
+            stalled_for = time.monotonic() - self._last_beat
+            if stalled_for < self.deadline_s:
+                continue
+            report = self._build_report(stalled_for)
+            self.last_report = report
+            if self._logger is not None:
+                self._logger.error("%s", report)
+            else:  # pragma: no cover - no logger wired
+                print(report, file=sys.stderr, flush=True)
+            if self._on_stall is not None:
+                try:
+                    self._on_stall(report)
+                except Exception:  # diagnostics must never kill the watcher
+                    pass
+            # Count LAST: a waiter polling stall_count sees the report
+            # fully built and delivered once the count moves.
+            if self._registry is not None:
+                self._registry.counter("watchdog/stalls").inc()
+            self.stall_count += 1
+            # Re-arm from now: one report per deadline window, not per poll.
+            self._last_beat = time.monotonic()
+
+    # -- the dump ----------------------------------------------------------
+
+    def _build_report(self, stalled_for: float) -> str:
+        lines = [
+            f"rocket_tpu watchdog: no step completed for {stalled_for:.1f}s "
+            f"(deadline {self.deadline_s:.1f}s) — dumping diagnostics",
+        ]
+        if self._spans is not None:
+            open_spans = self._spans.open_spans()
+            if open_spans:
+                lines.append("open spans (innermost last):")
+                for tid, stack in open_spans.items():
+                    lines.append(f"  [tid {tid}] " + " > ".join(stack))
+        lines.append(self._live_array_line())
+        thread_names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the watcher's own stack is noise
+            name = thread_names.get(tid, "?")
+            lines.append(f"thread {name} (tid {tid}):")
+            lines.append("".join(traceback.format_stack(frame)).rstrip())
+        return "\n".join(lines)
+
+    @staticmethod
+    def _live_array_line() -> str:
+        try:
+            import jax
+
+            arrays = jax.live_arrays()
+            total = sum(getattr(a, "nbytes", 0) or 0 for a in arrays)
+            return (
+                f"live jax arrays: {len(arrays)} "
+                f"({total / (1 << 20):.1f} MiB)"
+            )
+        except Exception as exc:  # backend gone mid-hang — still dump stacks
+            return f"live jax arrays: unavailable ({type(exc).__name__})"

@@ -80,6 +80,9 @@ class StrictMode:
         self.retrace_counts: dict[str, int] = {}
         #: label -> audited per-step collective-op count (note_collectives).
         self.collective_counts: dict[str, int] = {}
+        #: Optional Telemetry sink (runtime-wired): retrace / audited
+        #: collective counts mirror into its metrics registry.
+        self.telemetry = None
 
     @property
     def enabled(self) -> bool:
@@ -115,6 +118,9 @@ class StrictMode:
             return None
         count = int(cache_size())
         self.retrace_counts[label] = count
+        if self.telemetry is not None and self.telemetry.enabled:
+            # Host-side gauge store — no device op on the step path.
+            self.telemetry.registry.gauge(f"strict/retraces/{label}").set(count)
         if count > self.max_retraces:
             raise RuntimeError(
                 f"StrictMode: '{label}' has compiled {count} times "
@@ -135,6 +141,10 @@ class StrictMode:
         strict runs (``core/module.py``)."""
         count = int(count)
         self.collective_counts[label] = count
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.registry.gauge(
+                f"strict/audited_collectives/{label}"
+            ).set(count)
         return count
 
 
@@ -278,6 +288,22 @@ class Runtime:
         Opt into :class:`StrictMode` (transfer guard + retrace budget).
         None (default) reads ``ROCKET_TPU_STRICT`` from the environment;
         tune with ``strict_transfer_guard`` / ``strict_max_retraces``.
+    telemetry:
+        Opt into run-wide telemetry (``rocket_tpu.obs``): host span
+        tracing, goodput accounting, the metrics registry and (with
+        ``watchdog_secs``) the hang watchdog. None (default) reads
+        ``ROCKET_TPU_TELEMETRY``; ``telemetry.json`` + the Perfetto span
+        file are written at DESTROY into ``telemetry_dir`` (default:
+        the Tracker's ``runs/<project>``, else
+        ``<project_dir>/runs/telemetry``).
+    watchdog_secs:
+        Heartbeat deadline for the telemetry watchdog: when no Looper
+        iteration completes within this many seconds, all thread stacks
+        + the live span stack + live-array totals are dumped (run keeps
+        going). None (default) reads ``ROCKET_TPU_WATCHDOG``. An explicit
+        value implies ``telemetry=True`` when ``telemetry`` is left
+        unset (the env var does not — it only arms the watchdog on runs
+        that opted into telemetry).
     """
 
     #: Name of the batch-sharded mesh axis group. Parallel schemes that shard
@@ -307,6 +333,9 @@ class Runtime:
         strict: Optional[bool] = None,
         strict_transfer_guard: str = "disallow",
         strict_max_retraces: int = 8,
+        telemetry: Optional[bool] = None,
+        telemetry_dir: Optional[str] = None,
+        watchdog_secs: Optional[float] = None,
     ) -> None:
         _enable_compilation_cache()
         _maybe_initialize_distributed()
@@ -380,6 +409,46 @@ class Runtime:
         )
         if strict:
             self.strict.activate()
+
+        # Run-wide telemetry (rocket_tpu.obs): spans + goodput + metrics
+        # registry + watchdog, owned here so the whole capsule tree reports
+        # into ONE object and teardown has one flush point. Default: off;
+        # ROCKET_TPU_TELEMETRY=1 opts a run in without touching code.
+        from rocket_tpu.obs import Telemetry
+
+        if telemetry is None:
+            if watchdog_secs is not None:
+                # An explicit watchdog_secs= is an explicit ask for hang
+                # protection; the watchdog lives inside telemetry, so the
+                # ask implies the subsystem rather than silently no-opping.
+                telemetry = True
+            else:
+                telemetry = os.environ.get(
+                    "ROCKET_TPU_TELEMETRY", ""
+                ).strip().lower() in ("1", "true", "yes", "on")
+        elif not telemetry and watchdog_secs is not None:
+            self.get_logger("runtime").warning(
+                "watchdog_secs=%s ignored: telemetry=False disables the "
+                "whole obs subsystem, watchdog included.", watchdog_secs,
+            )
+        if watchdog_secs is None:
+            raw = os.environ.get("ROCKET_TPU_WATCHDOG", "").strip()
+            if raw:
+                try:
+                    watchdog_secs = float(raw)
+                except ValueError:
+                    self.get_logger("runtime").warning(
+                        "ROCKET_TPU_WATCHDOG=%r is not a number — watchdog "
+                        "disabled", raw,
+                    )
+        self.telemetry = Telemetry(
+            enabled=telemetry,
+            out_dir=telemetry_dir,
+            watchdog_secs=watchdog_secs,
+            logger=self.get_logger("obs"),
+        )
+        self.strict.telemetry = self.telemetry
+        self.telemetry.start()
 
         self._warned_replicated_batch = False
 
@@ -612,10 +681,27 @@ class Runtime:
         and release strict mode's process-global transfer guard — without
         this, a later non-strict Runtime in the same process would inherit
         the 'disallow' guard and raise on its own (legitimate) implicit
-        transfers."""
-        for tracker in self.trackers.values():
+        transfers.
+
+        Backend closes are exception-isolated: one backend's failing
+        ``close()`` (a dead wandb socket) must not leak the others' file
+        handles or skip the guard release — that leak is exactly the
+        JsonlBackend/SummaryWriter handle bug this teardown owns. The
+        telemetry flush runs LAST so the span file records the closes."""
+        logger = self.get_logger("runtime")
+        for name, tracker in list(self.trackers.items()):
             close = getattr(tracker, "close", None)
-            if close is not None:
+            if close is None:
+                continue
+            try:
                 close()
+            except Exception as exc:  # noqa: BLE001 — isolate per backend
+                logger.warning(
+                    "tracker backend %r failed to close: %r", name, exc
+                )
         self.trackers.clear()
         self.strict.deactivate()
+        self.telemetry.close(
+            default_dir=os.path.join(self.project_dir, "runs", "telemetry"),
+            write=self.is_main_process,
+        )

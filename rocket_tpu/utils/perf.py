@@ -9,12 +9,16 @@ import jax
 
 __all__ = ["PEAK_FLOPS", "peak_flops"]
 
-#: bf16 peak by device kind — MFU denominators.
+#: bf16 peak by device kind — MFU denominators. Matching is longest
+#: prefix, so "TPU v5 lite" (v5e) wins over "TPU v5" (v5p) and future
+#: suffixed kinds fall back to their family entry.
 PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,
-    "TPU v5": 459e12,
     "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
+    "TPU v6": 918e12,        # Trillium family (v6e is the only SKU)
+    "TPU v7": 2307e12,       # v7 (Ironwood): 4614 TFLOP/s fp8, half at bf16
 }
 
 

@@ -3,20 +3,59 @@
 SURVEY §4: the reference's 5-event protocol makes a probe capsule the natural
 test instrument (the survey itself verified the reference's event algebra with
 one); this framework ships it.
+
+Each trace entry is a :class:`ProbeEvent` — equality-compatible with the
+plain ``(name, event)`` tuples tests have always asserted against, but
+additionally carrying a monotonic timestamp (``.t``, ``time.perf_counter``)
+and the ``attrs.mode`` in force when the event fired (``.mode``), so event
+*ordering*, *timing* and *mode plumbing* are all assertable through the one
+instrument.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 
-__all__ = ["Probe"]
+__all__ = ["Probe", "ProbeEvent"]
+
+
+class ProbeEvent(tuple):
+    """A ``(name, event)`` tuple annotated with timing and mode.
+
+    ``ProbeEvent("a", "launch", ...) == ("a", "launch")`` — existing
+    tuple-shaped assertions keep working; ``.t`` is the monotonic capture
+    time and ``.mode`` the ``attrs.mode`` at dispatch (None outside a
+    Looper phase).
+    """
+
+    def __new__(cls, name: str, event: str, t: float, mode):
+        self = super().__new__(cls, (name, event))
+        self.t = t
+        self.mode = mode
+        return self
+
+    @property
+    def name(self) -> str:
+        return self[0]
+
+    @property
+    def event(self) -> str:
+        return self[1]
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (
+            f"ProbeEvent({self[0]!r}, {self[1]!r}, t={self.t:.6f}, "
+            f"mode={self.mode!r})"
+        )
 
 
 class Probe(Capsule):
-    """Records ``(name, event)`` tuples into a shared trace list."""
+    """Records a :class:`ProbeEvent` per received event into a shared trace
+    list."""
 
     def __init__(
         self,
@@ -31,7 +70,10 @@ class Probe(Capsule):
         self.trace = trace if trace is not None else []
 
     def _record(self, event: str, attrs: Attributes | None) -> None:
-        self.trace.append((self.name, event))
+        mode = attrs.mode if attrs is not None else None
+        self.trace.append(
+            ProbeEvent(self.name, event, time.perf_counter(), mode)
+        )
 
     def setup(self, attrs=None):
         super().setup(attrs)

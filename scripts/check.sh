@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
 # SPMD shard audit (self-gate + budget diff) + precision audit
-# (dtype-flow self-gate + numerics budgets) + the tier-1 test suite
-# (command from ROADMAP.md). Exits non-zero on the first failing stage.
+# (dtype-flow self-gate + numerics budgets) + obs telemetry smoke +
+# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on
+# the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,6 +29,13 @@ echo "== precision audit (dtype-flow self-gate + numerics budgets) =="
 # over tests/fixtures/budgets/prec/.
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis prec \
     --budgets tests/fixtures/budgets/prec
+
+echo "== obs smoke (telemetry + strict step path) =="
+# Tier-1 example run with telemetry on: telemetry.json must exist and
+# parse, goodput categories must sum to wall-clock, the span file must be
+# valid Chrome-trace JSON, and the strict transfer guard stays green with
+# instrumentation active.
+JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail

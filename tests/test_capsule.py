@@ -14,6 +14,31 @@ def test_dispatch_routes_to_handler(runtime):
     assert [e for _, e in trace] == ["setup", "set", "launch", "reset", "destroy"]
 
 
+def test_probe_records_timing_and_mode(runtime):
+    """Probe entries stay ==(name, event) tuples AND carry a monotonic
+    timestamp + the attrs.mode in force, so ordering, timing and mode
+    plumbing assert through one instrument."""
+    trace = []
+    probe = Probe("p", trace, runtime=runtime)
+    attrs = Attributes()
+    attrs.mode = "train"
+    probe.dispatch(Events.SET, attrs)
+    probe.dispatch(Events.LAUNCH, attrs)
+    attrs.mode = "eval"
+    probe.dispatch(Events.LAUNCH, attrs)
+    probe.dispatch(Events.RESET, None)
+
+    assert trace == [("p", "set"), ("p", "launch"), ("p", "launch"),
+                     ("p", "reset")]
+    # Timestamps are monotonic non-decreasing perf_counter captures.
+    times = [e.t for e in trace]
+    assert times == sorted(times)
+    assert trace[1].t > trace[0].t
+    # attrs.mode rides each record; None when no attrs were passed.
+    assert [e.mode for e in trace] == ["train", "train", "eval", None]
+    assert trace[0].name == "p" and trace[0].event == "set"
+
+
 def test_dispatch_rejects_non_event(runtime):
     capsule = Capsule(runtime=runtime)
     with pytest.raises(RuntimeError):

@@ -1,0 +1,46 @@
+"""utils/perf.py: the peak-FLOPs table and its longest-prefix matching."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from rocket_tpu.utils.perf import PEAK_FLOPS, peak_flops
+
+
+def _device(kind):
+    # peak_flops only reads .device_kind — a stub stands in for jax.Device.
+    return SimpleNamespace(device_kind=kind)
+
+
+@pytest.mark.parametrize(
+    "kind, expected_key",
+    [
+        # Longest prefix wins: the lite SKUs must not resolve to the
+        # family entry that prefixes them.
+        ("TPU v5 lite", "TPU v5 lite"),
+        ("TPU v5", "TPU v5"),
+        ("TPU v5p", "TPU v5"),
+        ("TPU v6 lite", "TPU v6 lite"),
+        ("TPU v6e", "TPU v6"),
+        ("TPU v6", "TPU v6"),
+        ("TPU v7", "TPU v7"),
+        ("TPU v7x", "TPU v7"),
+        ("TPU v4", "TPU v4"),
+    ],
+)
+def test_longest_prefix_device_kind_matching(kind, expected_key):
+    assert peak_flops(_device(kind)) == PEAK_FLOPS[expected_key]
+
+
+def test_unknown_kind_returns_none():
+    # Callers must omit MFU rather than divide by a wrong peak.
+    assert peak_flops(_device("cpu")) is None
+    assert peak_flops(_device("TPU v3")) is None
+
+
+def test_new_generations_present_and_ordered():
+    # The v6/v7 entries exist and peaks are monotone across generations.
+    assert PEAK_FLOPS["TPU v6"] >= PEAK_FLOPS["TPU v5"]
+    assert PEAK_FLOPS["TPU v7"] > PEAK_FLOPS["TPU v6"]
+    # v5 lite < v5 (the prefix pair the matcher exists for).
+    assert PEAK_FLOPS["TPU v5 lite"] < PEAK_FLOPS["TPU v5"]

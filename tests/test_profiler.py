@@ -4,6 +4,7 @@ import os
 
 import numpy as np
 import optax
+import pytest
 
 import rocket_tpu as rt
 from rocket_tpu import optim
@@ -74,3 +75,105 @@ def test_profiler_times_steps_and_writes_trace(tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         found += files
     assert found, f"no trace files under {trace_dir}"
+
+
+# -- trace window unit tests (satellite: start/stop boundaries, destroy,
+# -- scalar emission) — drive the capsule by hand with a spy on
+# -- jax.profiler so no real trace is captured.
+
+
+class TraceSpy:
+    def __init__(self, monkeypatch):
+        import jax
+
+        self.calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d: self.calls.append(("start", d)),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: self.calls.append(("stop", None)),
+        )
+
+    @property
+    def kinds(self):
+        return [kind for kind, _ in self.calls]
+
+
+def _drive(profiler, steps, attrs=None):
+    for _ in range(steps):
+        profiler.launch(attrs)
+
+
+def test_trace_window_opens_and_closes_at_boundaries(
+    runtime, tmp_path, monkeypatch
+):
+    spy = TraceSpy(monkeypatch)
+    profiler = rt.Profiler(
+        trace_dir=str(tmp_path / "tr"), trace_start=3, trace_steps=2,
+        runtime=runtime,
+    )
+    profiler.setup()
+    profiler.set()
+    # Window is [trace_start, trace_start + trace_steps): iter counts are
+    # pre-increment, so launch #4 (iter_idx==3) opens, launch #6 closes.
+    _drive(profiler, 3)
+    assert spy.calls == []
+    _drive(profiler, 1)
+    assert spy.kinds == ["start"]
+    _drive(profiler, 1)  # still inside the window
+    assert spy.kinds == ["start"]
+    _drive(profiler, 1)
+    assert spy.kinds == ["start", "stop"]
+    _drive(profiler, 3)  # window never reopens
+    assert spy.kinds == ["start", "stop"]
+    profiler.destroy()
+    assert spy.kinds == ["start", "stop"]  # nothing left open
+
+
+def test_destroy_closes_a_still_open_trace(runtime, tmp_path, monkeypatch):
+    spy = TraceSpy(monkeypatch)
+    profiler = rt.Profiler(
+        trace_dir=str(tmp_path / "tr"), trace_start=1, trace_steps=100,
+        runtime=runtime,
+    )
+    profiler.setup()
+    profiler.set()
+    _drive(profiler, 2)
+    assert spy.kinds == ["start"]  # window still open mid-run
+    profiler.destroy()  # early termination must close it
+    assert spy.kinds == ["start", "stop"]
+
+
+def test_perf_scalars_emitted_with_known_peak(runtime, monkeypatch):
+    """perf/steps_per_sec always lands after warmup; perf/mfu lands when
+    the device kind has a peak-FLOPs entry (faked for the CPU mesh)."""
+    from rocket_tpu.utils import perf
+
+    monkeypatch.setitem(perf.PEAK_FLOPS, "cpu", 1e12)
+    profiler = rt.Profiler(flops_per_step=1e9, warmup=1, runtime=runtime)
+    profiler.setup()
+    profiler.set()
+    attrs = rt.Attributes()
+    attrs.looper = rt.Attributes(state=rt.Attributes())
+    attrs.tracker = rt.Attributes(scalars=rt.Attributes())
+    _drive(profiler, 3, attrs)
+    scalars = attrs.tracker.scalars
+    assert scalars["perf/steps_per_sec"] > 0
+    assert scalars["perf/mfu"] == pytest.approx(
+        scalars["perf/steps_per_sec"] * 1e9 / (8 * 1e12)
+    )
+    assert attrs.looper.state.steps_per_sec > 0
+
+
+def test_no_mfu_on_unknown_device_kind(runtime):
+    profiler = rt.Profiler(flops_per_step=1e9, warmup=1, runtime=runtime)
+    profiler.setup()  # CPU kind has no real PEAK_FLOPS entry
+    profiler.set()
+    attrs = rt.Attributes()
+    attrs.looper = rt.Attributes(state=rt.Attributes())
+    attrs.tracker = rt.Attributes(scalars=rt.Attributes())
+    _drive(profiler, 3, attrs)
+    assert attrs.tracker.scalars["perf/steps_per_sec"] > 0
+    assert attrs.tracker.scalars["perf/mfu"] is None  # absent key reads None
