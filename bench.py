@@ -717,7 +717,54 @@ def telemetry_summary(path=None):
         return None
 
 
-def write_detail(results, path=DETAIL_PATH):
+def health_summary(warmup=10, steps=60, batch=1024):
+    """Sentinel overhead + anomaly accounting for BENCH_DETAIL.json.
+
+    The MLP config is timed twice — health sentinels OFF, then ON with
+    the gated ``skip_step`` action (the most expensive sentinel path:
+    per-branch finite checks, norms, the on-device EMA and the lax.cond
+    update gate, plus the lagged explicit host fetch). ``overhead_frac``
+    is the steps/sec cost of turning sentinels on, best-of-3-windows on
+    both sides so shared-chip contention noise largely cancels. Telemetry
+    stays OFF in both probes so the probe cannot masquerade as the main
+    run's telemetry record. Best effort: None on any failure — emission
+    must never die on observability."""
+    try:
+        sps = {}
+        stats = None
+        for mode in (False, True):
+            runtime = rt.Runtime(
+                seed=0, health=mode, anomaly_action="skip_step",
+                telemetry=False,
+            )
+            data = _class_dataset((784,), batch, warmup, steps)
+            model = MLP(in_features=784, num_classes=10, hidden=(512, 256))
+            module = rt.Module(
+                model,
+                capsules=[rt.Loss(cross_entropy),
+                          rt.Optimizer(optim.sgd(), learning_rate=0.01)],
+            )
+            timer = Timer(module, warmup, steps)
+            _train([rt.Dataset(data, batch_size=batch), module], runtime, timer)
+            sps[mode] = 1.0 / timer.best_step_time()
+            if mode:
+                stats = runtime.health.summary()
+        overhead = (sps[False] - sps[True]) / sps[False]
+        return {
+            "steps_per_sec_baseline": round(sps[False], 2),
+            "steps_per_sec_with_sentinels": round(sps[True], 2),
+            "overhead_frac": round(overhead, 4),
+            "action": stats["action"],
+            "anomalies": stats["anomalies"],
+            "skipped_steps": stats["skipped_steps"],
+            "config": "mlp",
+        }
+    except Exception as exc:  # noqa: BLE001 — best-effort, like the audits
+        log(f"bench: health_summary failed: {exc!r}")
+        return None
+
+
+def write_detail(results, path=DETAIL_PATH, health=None):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
     this file is the complete record it points at.
@@ -766,6 +813,11 @@ def write_detail(results, path=DETAIL_PATH):
         # bench run: measured compile/data-wait/step fractions next to the
         # throughput they explain.
         detail["telemetry"] = telemetry
+    if health is not None:
+        # Measured health-sentinel overhead (obs.health): steps/sec with
+        # the in-step sentinels + lax.cond gate on vs off, plus the
+        # probe's anomaly/skip accounting. Target: overhead_frac < 0.02.
+        detail["health_sentinels"] = health
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).
@@ -879,13 +931,23 @@ def main():
             log(f"bench: {name} FAILED: {exc!r}")
             results[name] = {"metric": METRIC_NAMES[name], "error": str(exc)}
 
+    # Sentinel-overhead probe (quick paired MLP run): measured AFTER the
+    # configs so it can never eat headline budget, skipped entirely when
+    # the budget is already blown.
+    health = None
+    if time.time() - start <= args.budget_s:
+        log("bench: health sentinel overhead probe ...")
+        health = health_summary()
+        if health is not None:
+            log(f"bench: health_summary -> {health}")
+
     # The stdout line is the hard contract and goes out FIRST — a kill or
     # hang during the best-effort detail write must not eat it. It still
     # ends up last in the tail capture because nothing else prints to
     # stdout after it.
     print(format_line(results), flush=True)
     try:
-        write_detail(results)
+        write_detail(results, health=health)
     except Exception as exc:  # noqa: BLE001 — detail file is best effort
         log(f"bench: could not write {DETAIL_PATH}: {exc!r}")
 

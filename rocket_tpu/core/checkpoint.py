@@ -75,6 +75,12 @@ class Checkpointer(Capsule):
 
     def setup(self, attrs: Attributes | None = None) -> None:
         super().setup(attrs)
+        flight = getattr(self._runtime, "flight", None)
+        if flight is not None:
+            # Register as the black-box bundle's emergency writer: on a
+            # forensic dump (anomaly halt / loop exception / watchdog
+            # escalation) the flight recorder calls save_emergency().
+            flight.attach_checkpointer(self)
         if self._resume_from:
             path = self._resolve_resume_path(self._resume_from)
             if path is not None:
@@ -242,6 +248,9 @@ class Checkpointer(Capsule):
         """Drain the async writer, then the usual teardown; the trailing
         barrier guarantees every host's shards exist before anyone resumes."""
         if self._runtime is not None:
+            flight = getattr(self._runtime, "flight", None)
+            if flight is not None:
+                flight.detach_checkpointer(self)
             with self._runtime.telemetry.span("checkpoint/drain",
                                               cat="checkpoint"):
                 self._writer.wait()
@@ -249,6 +258,34 @@ class Checkpointer(Capsule):
         else:
             self._writer.wait()
         super().destroy(attrs)
+
+    # -- emergency (black-box) save ----------------------------------------
+
+    def save_emergency(self, path: str) -> str:
+        """Synchronous, collective-free state dump into a black-box bundle
+        (called by the flight recorder mid-failure, possibly from a
+        watchdog thread while other hosts are wedged).
+
+        Deliberately NOT :meth:`save`: no barrier (other processes may be
+        hung — that is why we are dumping), no async writer (the process
+        may be about to die), no step-directory rotation. Each model's
+        state is snapshotted (explicit D2H of the addressable shards) and
+        written inline. Single-host bundles are directly resumable via
+        ``resume_from=<bundle>/checkpoint``; multi-host bundles carry this
+        process's chunks plus the index — forensic state, not a fleet
+        checkpoint. Under a gated anomaly action the state is the
+        last-good (finite) one, since the anomalous update was skipped.
+        """
+        runtime = self._runtime
+        for k, prepared in enumerate(runtime.models.values()):
+            plan = checkpoint_io.snapshot(prepared.state)
+            checkpoint_io.write_snapshot(os.path.join(path, f"model_{k}"), plan)
+        if runtime.is_main_process:
+            checkpoint_io.atomic_write(
+                os.path.join(path, "rng.json"),
+                json.dumps(runtime.rng_state_dict()).encode("utf-8"),
+            )
+        return path
 
     # -- restore -----------------------------------------------------------
 

@@ -214,6 +214,26 @@ class Looper(Dispatcher):
                              for k, v in attrs.looper.state.items()},
                             refresh=False,
                         )
+            health = getattr(self._runtime, "health", None)
+            if health is not None and health.enabled:
+                # Epoch end: decode the health words still inside their
+                # fetch lag (one batched explicit device_get) so an
+                # anomaly in the final steps acts THIS epoch — under
+                # dump_and_halt it raises here, not at teardown.
+                health.drain()
+        except Exception as exc:
+            # Black-box forensics: an exception escaping the step loop is
+            # exactly the "dead process with no trail" case — dump the
+            # flight recorder (sentinel history, spans tail, emergency
+            # checkpoint) before the stack unwinds. HealthAnomalyError
+            # already dumped inside the anomaly policy; the telemetry
+            # hook skips it. Re-raised unchanged either way.
+            if telemetry is not None:
+                telemetry.exception_dump(
+                    exc, tag=self._tag, epoch_idx=self._epoch_idx,
+                    batch_idx=self._batch_idx,
+                )
+            raise
         finally:
             if obs_on:
                 telemetry.watchdog_disarm()

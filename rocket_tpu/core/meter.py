@@ -278,9 +278,24 @@ class Metric(Capsule):
 
     def publish(self, attrs: Attributes | None, tag: str, value) -> None:
         """Route a finalized scalar to the tracker buffers and the live loop
-        state (the reference example's reset shape, examples/mnist.py:20-39)."""
+        state (the reference example's reset shape, examples/mnist.py:20-39).
+
+        With health monitoring on (``Runtime(health=True)``), a finalized
+        HOST scalar that comes out non-finite is counted as a health
+        signal — an eval metric going NaN is divergence the train-step
+        sentinels cannot see. Device scalars are left alone (checking
+        them here would put a sync on the eval path; they surface at the
+        tracker's flush instead)."""
         if attrs is not None:
             if attrs.tracker is not None:
                 attrs.tracker.scalars[tag] = value
             if attrs.looper is not None:
                 attrs.looper.state[tag] = value
+        health = getattr(self._runtime, "health", None)
+        if (
+            health is not None
+            and health.enabled
+            and isinstance(value, (int, float, np.floating))
+            and not np.isfinite(value)
+        ):
+            health.note_nonfinite_metric(tag)

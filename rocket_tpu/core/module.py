@@ -192,6 +192,7 @@ class Module(Dispatcher):
         self._train_step = None
         self._eval_step = None
         self._host_step: Optional[int] = None
+        self._health_label: Optional[str] = None
         # Per-mode "first call done" flags: the first invocation of a jitted
         # step blocks the host on trace+lower+compile, so telemetry wraps
         # exactly that call in an explicit "compile" span.
@@ -303,6 +304,24 @@ class Module(Dispatcher):
                 # the model.
                 prepared.state["ema_params"] = jax.tree.map(
                     jnp.copy, prepared.state["params"]
+                )
+            health_mon = getattr(runtime, "health", None)
+            if health_mon is not None and health_mon.enabled:
+                # Health sentinels (rocket_tpu.obs.health): the on-device
+                # EMA moments + skip/anomaly counters live in the donated
+                # train state and checkpoint with the model; the monitor
+                # learns the params tree's top-level branch order so the
+                # fetched health words decode with real branch names.
+                from rocket_tpu.obs import health as health_lib
+
+                if "health" not in prepared.state:
+                    prepared.state["health"] = health_lib.init_state()
+                # register_step may disambiguate the label (two Modules
+                # wrapping the same model class) — observe under what it
+                # returns.
+                self._health_label = health_mon.register_step(
+                    f"train_step[{type(self._model).__name__}]",
+                    health_lib.branch_names(prepared.state["params"]),
                 )
             self._build_train_step(objective, tx, report_grad_norm=report_grad_norm)
         elif objective is not None:
@@ -482,6 +501,20 @@ class Module(Dispatcher):
         ema_decay = self._ema_decay
         batch_transform = self._batch_transform
 
+        # Health sentinels: config captured statically at build time so the
+        # compiled step carries no host handles; `health_gate` decides
+        # whether the optimizer application is wrapped in lax.cond on the
+        # step-ok predicate (skip_step / dump_and_halt keep state finite).
+        health_mon = getattr(runtime, "health", None)
+        hcfg = (
+            health_mon.config
+            if health_mon is not None and health_mon.enabled
+            else None
+        )
+        health_gate = hcfg.gated if hcfg is not None else False
+        if hcfg is not None:
+            from rocket_tpu.obs import health as health_lib
+
         def ema_update(ema, params):
             # ema += (1-d) * (params - ema) — one fused pass per leaf.
             return jax.tree.map(
@@ -524,23 +557,74 @@ class Module(Dispatcher):
             new_state["model_state"] = mstate
             new_state["step"] = state["step"] + 1
 
-            if accum == 1:
-                updates, opt_state = tx.update(
-                    grads, state["opt_state"], state["params"]
+            if hcfg is not None:
+                # Pre-update sentinels: the gate predicate must exist
+                # before any state is touched. Flags and the global grad
+                # norm come out of one shared pass over the grads.
+                step_ok, loss_ok, grad_branch_ok, health_grad_norm = (
+                    health_lib.step_flags(loss, grads)
                 )
-                new_state["params"] = optax.apply_updates(state["params"], updates)
+            else:
+                step_ok = None
+
+            if accum == 1:
+                ema_in = state["ema_params"] if ema_decay is not None else {}
+
+                def apply_update1(operand):
+                    grads, params, opt_state, ema = operand
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    # Sentinel update-norm reads the updates while they
+                    # are live, inside this branch — computing ‖Δθ‖ from
+                    # old-vs-new params outside would pin the donated old
+                    # param buffers across the update.
+                    unorm = (
+                        optax.global_norm(updates)
+                        if hcfg is not None
+                        else jnp.zeros((), jnp.float32)
+                    )
+                    params = optax.apply_updates(params, updates)
+                    if ema_decay is not None:
+                        ema = ema_update(ema, params)
+                    return params, opt_state, ema, unorm
+
+                def hold1(operand):
+                    _grads, params, opt_state, ema = operand
+                    # update_norm 0: a held step moved nothing.
+                    return params, opt_state, ema, jnp.zeros((), jnp.float32)
+
+                operand = (grads, state["params"], state["opt_state"], ema_in)
+                if health_gate:
+                    # A non-finite loss/grad step must not touch params,
+                    # moments or the EMA — the whole update is gated on
+                    # the health predicate (the skip is counted in the
+                    # sentinel state below).
+                    params_out, opt_state, ema_out, update_norm = (
+                        jax.lax.cond(step_ok, apply_update1, hold1, operand)
+                    )
+                else:
+                    params_out, opt_state, ema_out, update_norm = (
+                        apply_update1(operand)
+                    )
+                new_state["params"] = params_out
                 new_state["opt_state"] = opt_state
                 opt_step = state["step"]
                 if ema_decay is not None:
-                    new_state["ema_params"] = ema_update(
-                        state["ema_params"], new_state["params"]
-                    )
+                    new_state["ema_params"] = ema_out
             else:
                 # The accumulation phase is DERIVED from the step counter —
                 # host and device compute the same boundary from the same
                 # number, so there is no second counter to drift across
                 # epochs or resumes.
-                acc = jax.tree.map(jnp.add, state["grad_accum"], grads)
+                if health_gate:
+                    # A non-finite microbatch must not poison the window:
+                    # its grads are dropped from the accumulator and the
+                    # boundary update applies the finite remainder.
+                    acc = jax.tree.map(
+                        lambda a, g: jnp.where(step_ok, a + g, a),
+                        state["grad_accum"], grads,
+                    )
+                else:
+                    acc = jax.tree.map(jnp.add, state["grad_accum"], grads)
                 is_boundary = (state["step"] + 1) % accum == 0
                 opt_step = state["step"] // accum
 
@@ -555,19 +639,29 @@ class Module(Dispatcher):
                         else jnp.zeros((), jnp.float32)
                     )
                     updates, opt_state = tx.update(mean_grads, opt_state, params)
+                    # Sentinel update-norm on the live updates, inside
+                    # the branch (donation-friendly — see accum==1).
+                    unorm = (
+                        optax.global_norm(updates)
+                        if hcfg is not None
+                        else jnp.zeros((), jnp.float32)
+                    )
                     params = optax.apply_updates(params, updates)
                     if ema_decay is not None:
                         ema = ema_update(ema, params)
-                    return _tree_zeros_like(acc), params, opt_state, ema, gn
+                    return (_tree_zeros_like(acc), params, opt_state, ema, gn,
+                            unorm)
 
                 def hold(operand):
                     acc, params, opt_state, ema = operand
-                    return acc, params, opt_state, ema, jnp.zeros((), jnp.float32)
+                    zero = jnp.zeros((), jnp.float32)
+                    return acc, params, opt_state, ema, zero, zero
 
                 # The EMA rides the cond operands even when off (empty dict)
                 # so both branches share one signature.
                 ema_in = state["ema_params"] if ema_decay is not None else {}
-                acc, params, opt_state, ema_out, accum_grad_norm = jax.lax.cond(
+                (acc, params, opt_state, ema_out, accum_grad_norm,
+                 update_norm) = jax.lax.cond(
                     is_boundary,
                     apply_update,
                     hold,
@@ -582,7 +676,12 @@ class Module(Dispatcher):
             if accum == 1:
                 loss_window = loss
             else:
-                loss_acc = state["loss_acc"] + loss / accum
+                loss_contrib = loss / accum
+                if health_gate:
+                    # Mirror the accumulator gate: a skipped microbatch's
+                    # (non-finite) loss must not poison the window mean.
+                    loss_contrib = jnp.where(step_ok, loss_contrib, 0.0)
+                loss_acc = state["loss_acc"] + loss_contrib
                 loss_window = jnp.where(is_boundary, loss_acc, 0.0)
                 new_state["loss_acc"] = jnp.where(is_boundary, 0.0, loss_acc)
 
@@ -605,6 +704,36 @@ class Module(Dispatcher):
                 # MoE capacity-overflow fraction: a scalar worth tracking
                 # even when the (large) output batch isn't returned.
                 metrics["moe_frac_dropped"] = out["moe_frac_dropped"]
+            if hcfg is not None:
+                # Post-update sentinel half: fold this step into the
+                # on-device EMA/counters and coalesce everything into ONE
+                # small health word — the only array the host ever fetches
+                # (lagged, explicit). Param flags + norm come from one
+                # pass over the NEW params, so an update that corrupted
+                # state flags here.
+                new_state["health"], health_word, hextras = (
+                    health_lib.update_sentinels(
+                        state["health"],
+                        loss=loss,
+                        step=state["step"],
+                        step_ok=step_ok,
+                        loss_ok=loss_ok,
+                        grad_branch_ok=grad_branch_ok,
+                        grad_norm=health_grad_norm,
+                        update_norm=update_norm,
+                        new_params=new_state["params"],
+                        gated=health_gate,
+                        ema_decay=hcfg.ema_decay,
+                        zscore_max=hcfg.zscore_max,
+                        zscore_warmup=hcfg.zscore_warmup,
+                    )
+                )
+                metrics["health_word"] = health_word
+                # Scalar sentinels ride the step-metrics channel too, so
+                # the Optimizer can publish them to the tracker/postfix
+                # like lr/grad_norm (device scalars, no sync).
+                metrics["health/update_ratio"] = hextras["update_ratio"]
+                metrics["health/param_norm"] = hextras["param_norm"]
             if return_out:
                 metrics["outputs"] = out
             return new_state, metrics
@@ -661,7 +790,24 @@ class Module(Dispatcher):
             accum = self._runtime.gradient_accumulation_steps
             attrs.sync_gradients = (self._host_step % accum) == 0
             outputs = metrics.pop("outputs", None)
+            health_word = metrics.pop("health_word", None)
             attrs.step_metrics = Attributes(metrics)
+            if health_word is not None:
+                # Hand the (device) health word to the monitor with its
+                # host-side step context; the monitor fetches it only once
+                # it is fetch_lag steps old (explicit, non-stalling
+                # device_get) and applies the anomaly policy — under
+                # dump_and_halt this is the call that raises.
+                context = {}
+                if attrs.looper is not None:
+                    context["tag"] = attrs.looper.tag
+                if attrs.launcher is not None:
+                    context["epoch"] = attrs.launcher.epoch_idx
+                if attrs.batch_info is not None and attrs.batch_info.index is not None:
+                    context["batch_index"] = attrs.batch_info.index
+                self._runtime.health.observe(
+                    self._health_label, self._host_step, health_word, context
+                )
             strict = self._runtime.strict
             if strict.enabled:
                 # Retrace budget: a host-side cache-size read (no device

@@ -83,6 +83,21 @@ class Optimizer(Capsule):
                 attrs.tracker.scalars["grad_norm"] = attrs.step_metrics.grad_norm
             if attrs.looper is not None:
                 attrs.looper.state.grad_norm = attrs.step_metrics.grad_norm
+        if attrs.step_metrics is not None:
+            # Health sentinels computed inside the compiled step (present
+            # when Runtime(health=True)): the update ratio ‖Δθ‖/‖θ‖ and
+            # the global param norm — device scalars riding the same
+            # no-sync channel as lr/grad_norm, materialized only at the
+            # tracker's flush boundary.
+            ratio = attrs.step_metrics["health/update_ratio"]
+            if ratio is not None:
+                if attrs.tracker is not None:
+                    attrs.tracker.scalars["health/update_ratio"] = ratio
+                if attrs.looper is not None:
+                    attrs.looper.state.update_ratio = ratio
+            pnorm = attrs.step_metrics["health/param_norm"]
+            if pnorm is not None and attrs.tracker is not None:
+                attrs.tracker.scalars["health/param_norm"] = pnorm
 
     # -- checkpoint state (optimizer.py:81-85). Wired, but OFF by default:
     # saved only when constructed with statefull=True — the optimizer's

@@ -288,10 +288,19 @@ class Tracker(Capsule):
                 # registry counters/gauges (HBM watermarks, compile
                 # events, queue depth, goodput fractions) — host floats,
                 # no device fetch beyond the explicit ones above.
+                # Training-health sentinels (health/*) keep their own
+                # top-level namespace: anomaly counts and update ratios
+                # belong next to the loss curve, not buried under the
+                # observability internals. Registry keys already under
+                # obs/ (spans_dropped) pass through un-doubled.
                 obs_scalars = telemetry.scalars_snapshot()
                 if obs_scalars:
                     self._backend.log_scalars(
-                        {f"obs/{k}": v for k, v in obs_scalars.items()},
+                        {
+                            (k if k.startswith(("health/", "obs/"))
+                             else f"obs/{k}"): v
+                            for k, v in obs_scalars.items()
+                        },
                         self._iter_idx,
                     )
         # Reset buffers, bump the global step (tracker.py:114-117).

@@ -1,14 +1,27 @@
-"""rocket_tpu.obs — run-wide telemetry: spans, goodput, metrics, watchdog.
+"""rocket_tpu.obs — run-wide telemetry: spans, goodput, metrics, watchdog,
+training-health sentinels and the black-box flight recorder.
 
 Enable per run with ``Runtime(telemetry=True)`` (or
 ``ROCKET_TPU_TELEMETRY=1``); the runtime owns one :class:`Telemetry`
 object the whole capsule tree reports into, and writes
 ``<runs dir>/telemetry.json`` plus a Perfetto-loadable
 ``spans.trace.json`` at DESTROY. Render either with
-``python -m rocket_tpu.obs report <file>``. See docs/observability.md.
+``python -m rocket_tpu.obs report <file>``.
+
+``Runtime(health=True)`` (or ``ROCKET_TPU_HEALTH=1``) additionally fuses
+health sentinels into the compiled train step (``obs/health.py``) and
+arms the flight recorder (``obs/flight.py``) whose forensic bundles land
+under ``<runs dir>/blackbox/`` — render with
+``python -m rocket_tpu.obs blackbox <bundle>``. See docs/observability.md.
 """
 
+from rocket_tpu.obs.flight import FlightRecorder
 from rocket_tpu.obs.goodput import CATEGORIES, Goodput, render_report
+from rocket_tpu.obs.health import (
+    HealthAnomalyError,
+    HealthConfig,
+    HealthMonitor,
+)
 from rocket_tpu.obs.registry import Counter, Gauge, Histogram, MetricsRegistry
 from rocket_tpu.obs.spans import SpanRecorder, load_chrome_trace
 from rocket_tpu.obs.telemetry import Telemetry
@@ -17,8 +30,12 @@ from rocket_tpu.obs.watchdog import Watchdog
 __all__ = [
     "CATEGORIES",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Goodput",
+    "HealthAnomalyError",
+    "HealthConfig",
+    "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
     "SpanRecorder",

@@ -1,24 +1,51 @@
-"""CLI: ``python -m rocket_tpu.obs report <telemetry.json | spans file>``.
+"""CLI: ``python -m rocket_tpu.obs <report|blackbox> <path>``.
 
-Renders a run's telemetry record as the goodput table plus the key
-registry metrics. Given a Chrome-trace span file instead, it validates
-the file and reconstructs per-category inclusive totals from the span
-events. Exit contract matches the analysis CLIs: 0 = rendered, 2 =
-usage/parse error.
+``report`` renders a run's telemetry record as the goodput table plus the
+key registry metrics. Given a Chrome-trace span file instead, it
+validates the file and reconstructs per-category inclusive totals from
+the span events. A telemetry.json from a zero-step run renders an
+explicit "no steps recorded" row (never a crash on the degenerate
+record).
+
+``blackbox`` renders a flight-recorder forensic bundle
+(``runs/<project>/blackbox/<reason>/``, or its ``blackbox.json``
+directly): the dump reason, last-good step, anomaly timeline, the tail
+of the sentinel history, and whether an emergency checkpoint rode along.
+
+Exit contract matches the analysis CLIs: 0 = rendered, 2 = usage/parse
+error.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
+import os
 import sys
 
+from rocket_tpu.obs.flight import BLACKBOX_FILE
 from rocket_tpu.obs.goodput import CATEGORIES, render_report
 from rocket_tpu.obs.spans import load_chrome_trace
 
 
 def _report_telemetry(doc: dict) -> str:
     lines = [render_report(doc.get("goodput", {}))]
+    health = doc.get("health")
+    if health:
+        lines.append("")
+        lines.append(
+            f"health: action={health.get('action')} "
+            f"anomalies={health.get('anomalies', 0)} "
+            f"skipped_steps={health.get('skipped_steps', 0)} "
+            f"zscore_breaches={health.get('zscore_breaches', 0)} "
+            f"last_good_step={health.get('last_good_step')}"
+        )
+    blackbox = doc.get("blackbox", {})
+    if blackbox.get("bundles"):
+        lines.append("blackbox bundles:")
+        for bundle in blackbox["bundles"]:
+            lines.append(f"  {bundle}")
     metrics = doc.get("metrics", {})
     scalars = dict(metrics.get("counters", {}))
     scalars.update(metrics.get("gauges", {}))
@@ -26,7 +53,11 @@ def _report_telemetry(doc: dict) -> str:
         lines.append("")
         lines.append("metrics:")
         for name in sorted(scalars):
-            lines.append(f"  {name:<36} {scalars[name]:g}")
+            value = scalars[name]
+            # Non-finite values are stored as their string names so the
+            # file stays strict JSON (telemetry._json_safe).
+            rendered = f"{value:g}" if isinstance(value, (int, float)) else str(value)
+            lines.append(f"  {name:<36} {rendered}")
     for name, hist in sorted(metrics.get("histograms", {}).items()):
         mean = hist.get("mean")
         lines.append(
@@ -82,33 +113,161 @@ def _report_spans(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def _fmt(value, digits=4) -> str:
+    if isinstance(value, float) and not math.isfinite(value):
+        return str(value)  # nan / inf — the whole point of the record
+    if isinstance(value, float):
+        return f"{value:.{digits}g}"
+    return str(value)
+
+
+def _render_blackbox(manifest: dict, bundle_dir: str) -> str:
+    """The post-mortem view: what happened, when it was last healthy,
+    and the evidence trail."""
+    lines = [
+        f"black-box bundle: {bundle_dir or '(manifest only)'}",
+        f"reason: {manifest.get('reason')}",
+        f"last good step: {manifest.get('last_good_step')}",
+        f"steps recorded: {manifest.get('steps_recorded', 0)} "
+        f"(ring of sentinel snapshots)",
+    ]
+    process = manifest.get("process")
+    if process:
+        lines.append(
+            f"process: {process.get('index')}/{process.get('count')} "
+            f"(pid {process.get('pid')})"
+        )
+    health = manifest.get("health")
+    if health:
+        lines.append(
+            f"health: action={health.get('action')} "
+            f"anomalies={health.get('anomalies', 0)} "
+            f"skipped_steps={health.get('skipped_steps', 0)}"
+        )
+
+    anomalies = manifest.get("anomalies") or []
+    lines.append("")
+    if anomalies:
+        lines.append(f"anomaly timeline ({len(anomalies)} record(s)):")
+        lines.append(
+            f"  {'step':>8} {'flags':<28} {'loss':>12} {'grad_norm':>12} "
+            f"{'zscore':>8}"
+        )
+        for rec in anomalies:
+            flags = "+".join(rec.get("flag_names", [])) or "-"
+            branch_bits = []
+            if rec.get("bad_grad_branches"):
+                branch_bits.append(f"grads[{','.join(rec['bad_grad_branches'])}]")
+            if rec.get("bad_param_branches"):
+                branch_bits.append(
+                    f"params[{','.join(rec['bad_param_branches'])}]"
+                )
+            lines.append(
+                f"  {rec.get('step', '?'):>8} {flags:<28} "
+                f"{_fmt(rec.get('loss')):>12} {_fmt(rec.get('grad_norm')):>12} "
+                f"{_fmt(rec.get('loss_zscore'), 3):>8}"
+                + ("  " + " ".join(branch_bits) if branch_bits else "")
+            )
+    else:
+        lines.append("anomaly timeline: empty (dump was not anomaly-driven)")
+
+    history = manifest.get("sentinel_history") or []
+    if history:
+        tail = history[-10:]
+        lines.append("")
+        lines.append(f"sentinel history tail (last {len(tail)} of {len(history)}):")
+        lines.append(
+            f"  {'step':>8} {'loss':>12} {'grad_norm':>12} {'upd_ratio':>10} "
+            f"{'flags'}"
+        )
+        for rec in tail:
+            lines.append(
+                f"  {rec.get('step', '?'):>8} {_fmt(rec.get('loss')):>12} "
+                f"{_fmt(rec.get('grad_norm')):>12} "
+                f"{_fmt(rec.get('update_ratio'), 3):>10} "
+                f"{'+'.join(rec.get('flag_names', [])) or '-'}"
+            )
+
+    ckpt = manifest.get("checkpoint")
+    if ckpt:
+        ckpt_dir = os.path.join(bundle_dir, ckpt) if bundle_dir else ckpt
+        present = os.path.isdir(ckpt_dir)
+        lines.append("")
+        lines.append(
+            f"emergency checkpoint: {ckpt_dir}"
+            + ("" if present else " (MISSING on disk)")
+        )
+    elif manifest.get("checkpoint_error"):
+        lines.append("")
+        lines.append(
+            f"emergency checkpoint FAILED: {manifest['checkpoint_error']}"
+        )
+    else:
+        lines.append("")
+        lines.append("emergency checkpoint: none (no Checkpointer in the tree)")
+
+    spans_tail = manifest.get("spans_tail") or []
+    if spans_tail:
+        lines.append(f"span tail: {len(spans_tail)} events (host timeline before the dump)")
+    extra = manifest.get("extra")
+    if isinstance(extra, dict) and extra.get("report"):
+        lines.append("")
+        lines.append("watchdog report:")
+        lines.append(str(extra["report"]))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.obs",
-        description="render a rocket_tpu telemetry record",
+        description="render rocket_tpu telemetry records and black-box bundles",
     )
     sub = parser.add_subparsers(dest="command")
     report = sub.add_parser(
         "report", help="render telemetry.json or a Chrome-trace span file"
     )
     report.add_argument("path", help="telemetry.json or spans.trace.json")
+    blackbox = sub.add_parser(
+        "blackbox", help="render a flight-recorder forensic bundle"
+    )
+    blackbox.add_argument(
+        "path", help=f"bundle directory or its {BLACKBOX_FILE}"
+    )
     args = parser.parse_args(argv)
-    if args.command != "report":
+    if args.command not in ("report", "blackbox"):
         parser.print_help()
         return 2
 
+    path = args.path
+    if args.command == "blackbox":
+        if os.path.isdir(path):
+            bundle_dir, path = path, os.path.join(path, BLACKBOX_FILE)
+        else:
+            bundle_dir = os.path.dirname(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"error: cannot read {path}: {exc}", file=sys.stderr)
+            return 2
+        if not isinstance(manifest, dict) or "reason" not in manifest:
+            print(f"error: {path} is not a black-box manifest", file=sys.stderr)
+            return 2
+        print(_render_blackbox(manifest, bundle_dir))
+        return 0
+
     try:
-        with open(args.path, "r", encoding="utf-8") as f:
+        with open(path, "r", encoding="utf-8") as f:
             doc = json.load(f)
     except (OSError, json.JSONDecodeError) as exc:
-        print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
+        print(f"error: cannot read {path}: {exc}", file=sys.stderr)
         return 2
 
     if isinstance(doc, dict) and "goodput" in doc:
         print(_report_telemetry(doc))
         return 0
     try:
-        events = load_chrome_trace(args.path)
+        events = load_chrome_trace(path)
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -105,17 +105,36 @@ class Goodput:
 
 
 def render_report(report: dict) -> str:
-    """The goodput table, for the ``python -m rocket_tpu.obs report`` CLI."""
-    total = report.get("total_wall_s", 0.0)
+    """The goodput table, for the ``python -m rocket_tpu.obs report`` CLI.
+
+    Robust to partial records: a zero-step run (crash before the first
+    wave, empty dataset) may carry ``total_wall_s: 0`` and no
+    ``fractions`` block — fractions are then derived here with a
+    guarded division (never ZeroDivisionError) and the step row is
+    replaced by an explicit "no steps recorded" marker instead of a
+    meaningless 0.0%."""
+    total = float(report.get("total_wall_s", 0.0) or 0.0)
+    categories = report.get("categories", {})
+    fractions = report.get("fractions") or {
+        cat: (seconds / total if total > 0 else 0.0)
+        for cat, seconds in categories.items()
+    }
+    no_steps = float(categories.get("step", 0.0) or 0.0) == 0.0
+    headline = (
+        "no steps recorded"
+        if no_steps
+        else f"{report.get('goodput_fraction', 0.0):.1%}"
+    )
     lines = [
         f"total wall-clock: {total:.3f}s   "
-        f"goodput (step fraction): {report.get('goodput_fraction', 0.0):.1%}",
+        f"goodput (step fraction): {headline}",
         f"{'phase':<12} {'seconds':>10} {'fraction':>9}",
     ]
-    categories = report.get("categories", {})
-    fractions = report.get("fractions", {})
     for cat in CATEGORIES:
         if cat not in categories:
+            continue
+        if cat == "step" and no_steps:
+            lines.append(f"{'step':<12} {'(no steps recorded)':>21}")
             continue
         lines.append(
             f"{cat:<12} {categories[cat]:>10.3f} {fractions.get(cat, 0.0):>8.1%}"

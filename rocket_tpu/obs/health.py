@@ -1,0 +1,601 @@
+"""Training-health sentinels — a health word computed inside the compiled step.
+
+PR 4's telemetry answers "where did the wall-clock go"; nothing watched
+what the *numbers* do. A NaN in step 41,203 of a long run otherwise
+surfaces as a silently-diverged loss curve or a dead process with no
+trail. This module closes that gap in two halves:
+
+* **Device half** (pure ``jnp``, fused into the Module's jitted
+  ``train_step``): per-step sentinels — non-finite flags for loss, grads
+  and params *per top-level tree branch*, the global grad norm, param
+  norm, update ratio (‖Δparams‖/‖params‖) and a loss z-score against an
+  on-device EMA — coalesced into ONE small f32 device array (the *health
+  word*). A tiny on-device state (EMA moments + skip/anomaly counters)
+  lives in the donated train state and is checkpointed with it. When the
+  anomaly action gates updates, the optimizer application is wrapped in
+  ``lax.cond`` on the step-ok predicate so a non-finite loss/grad step
+  leaves params, moments and EMA untouched (state stays finite).
+
+* **Host half** (:class:`HealthMonitor`): the Module hands the health
+  word over after each step; the monitor holds it in a short queue and
+  fetches it with an **explicit** ``jax.device_get`` only once it is
+  ``fetch_lag`` steps old — by then the step that produced it has
+  retired, so the fetch cannot stall the dispatch pipeline and the step
+  path stays sync-free under ``Runtime(strict=True)`` (explicit
+  transfers are legal under the transfer guard). Decoded records feed
+  the metrics registry (``health/*``), the flight recorder ring
+  (:mod:`rocket_tpu.obs.flight`), and the anomaly policy:
+
+  ==================  =====================================================
+  ``warn``            log + count, keep going
+  ``skip_step``       device-side ``lax.cond`` gate already skipped the
+                      update; log + count the skip
+  ``dump_and_halt``   write a forensic black-box bundle (flight recorder)
+                      and raise :class:`HealthAnomalyError`
+  ==================  =====================================================
+
+Enable via ``Runtime(health=True, anomaly_action=...)`` or
+``ROCKET_TPU_HEALTH=1|warn|skip_step|dump_and_halt``. See
+docs/observability.md ("Training health & black-box forensics").
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "ANOMALY_ACTIONS",
+    "HealthAnomalyError",
+    "HealthConfig",
+    "HealthMonitor",
+    "branch_names",
+    "decode_word",
+    "init_state",
+    "step_flags",
+    "update_sentinels",
+    "word_length",
+]
+
+#: Valid ``Runtime(anomaly_action=)`` values.
+ANOMALY_ACTIONS = ("warn", "skip_step", "dump_and_halt")
+
+# -- health-word layout ------------------------------------------------------
+# Fixed header slots, then one grad flag and one param flag per top-level
+# params branch. Everything is f32 — one small coalesced device array.
+SLOT_STEP = 0
+SLOT_FLAGS = 1
+SLOT_LOSS = 2
+SLOT_LOSS_Z = 3
+SLOT_GRAD_NORM = 4
+SLOT_PARAM_NORM = 5
+SLOT_UPDATE_RATIO = 6
+SLOT_SKIPPED = 7
+SLOT_ANOMALIES = 8
+#: f32 holds integers exactly only up to 2^24 — a production run blows
+#: past that, and step identity is the one thing forensics must not get
+#: wrong. The step is split step = hi * 2^20 + lo with both halves < 2^24.
+SLOT_STEP_HI = 9
+HEADER_SLOTS = 10
+
+_STEP_SPLIT = 1 << 20
+
+#: Flag bits in SLOT_FLAGS.
+FLAG_LOSS_NONFINITE = 1
+FLAG_GRADS_NONFINITE = 2
+FLAG_PARAMS_NONFINITE = 4
+FLAG_LOSS_ZSCORE = 8
+
+_FLAG_NAMES = {
+    FLAG_LOSS_NONFINITE: "loss_nonfinite",
+    FLAG_GRADS_NONFINITE: "grads_nonfinite",
+    FLAG_PARAMS_NONFINITE: "params_nonfinite",
+    FLAG_LOSS_ZSCORE: "loss_zscore_breach",
+}
+
+#: Bits that mean "this step's numbers are corrupt" (the gating / policy
+#: anomaly). A z-score breach is a divergence *warning*, never gated on.
+_ANOMALY_MASK = FLAG_LOSS_NONFINITE | FLAG_GRADS_NONFINITE | FLAG_PARAMS_NONFINITE
+
+
+@dataclass
+class HealthConfig:
+    """Knobs for the sentinel subsystem (owned by the Runtime)."""
+
+    enabled: bool = False
+    #: One of :data:`ANOMALY_ACTIONS`.
+    action: str = "warn"
+    #: Fetch the health word only once it is this many steps old — the
+    #: producing step has retired by then, so the explicit device_get
+    #: cannot stall dispatch.
+    fetch_lag: int = 2
+    #: Loss EMA decay for the z-score baseline.
+    ema_decay: float = 0.98
+    #: |z| above this (post-warmup) sets FLAG_LOSS_ZSCORE.
+    zscore_max: float = 8.0
+    #: Steps of EMA warmup before the z-score flag can fire.
+    zscore_warmup: int = 20
+
+    def __post_init__(self) -> None:
+        if self.action not in ANOMALY_ACTIONS:
+            raise ValueError(
+                f"anomaly_action must be one of {ANOMALY_ACTIONS}, "
+                f"got {self.action!r}"
+            )
+        if self.fetch_lag < 1:
+            raise ValueError(f"health fetch_lag must be >= 1, got {self.fetch_lag}")
+
+    @property
+    def gated(self) -> bool:
+        """Whether the compiled step gates the optimizer update on the
+        step-ok predicate (both halting actions keep state finite so the
+        emergency checkpoint in the black-box bundle is usable)."""
+        return self.action in ("skip_step", "dump_and_halt")
+
+
+class HealthAnomalyError(RuntimeError):
+    """Raised by the monitor under ``anomaly_action="dump_and_halt"``;
+    carries the decoded sentinel record and the bundle path (if written)."""
+
+    def __init__(self, message: str, record: Optional[dict] = None,
+                 bundle: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.record = record
+        self.bundle = bundle
+
+
+# -- device half (pure jnp; called from inside the jitted train step) --------
+
+
+def branch_names(params) -> tuple[str, ...]:
+    """Top-level branch labels of a params tree: dict keys for a mapping,
+    a single root label otherwise. Sorted so the host decoder and the
+    compiled word agree on slot order forever."""
+    if isinstance(params, dict) and params:
+        return tuple(sorted(str(k) for k in params))
+    return ("params",)
+
+
+def _branches(params) -> list:
+    if isinstance(params, dict) and params:
+        return [params[k] for k in sorted(params, key=str)]
+    return [params]
+
+
+def word_length(n_branches: int) -> int:
+    return HEADER_SLOTS + 2 * n_branches
+
+
+def init_state():
+    """On-device sentinel state: lives in the donated train state under
+    ``state["health"]`` and checkpoints with the model."""
+    import jax.numpy as jnp
+
+    return {
+        "loss_ema": jnp.zeros((), jnp.float32),
+        "loss_sq_ema": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.int32),
+        "skipped": jnp.zeros((), jnp.int32),
+        "anomalies": jnp.zeros((), jnp.int32),
+    }
+
+
+def branch_sumsq(tree):
+    """f32 vector of per-top-level-branch sums of squares (f32
+    accumulation), in :func:`branch_names` order.
+
+    This is the sentinels' cost discipline: ONE pass over the tree yields
+    both the per-branch finite flags (``isfinite(sumsq)`` — any NaN/Inf
+    leaf poisons its branch's sum) and the global norm
+    (``sqrt(sum(sumsq))``), instead of a separate ``isfinite`` sweep plus
+    a norm pass. Caveat, by design: a legitimately finite branch whose
+    sum of squares overflows f32 (norm > ~1.8e19) reads as non-finite —
+    at that magnitude the run is lost anyway, and flagging it is the
+    sentinel doing its job.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for branch in _branches(tree):
+        sqs = [
+            jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+            for leaf in jax.tree.leaves(branch)
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        ]
+        total = sqs[0] if sqs else jnp.zeros((), jnp.float32)
+        for sq in sqs[1:]:
+            total = total + sq
+        out.append(total)
+    return jnp.stack(out)
+
+
+def branch_finite_flags(tree):
+    """f32 vector (1.0 = finite) per top-level branch, in
+    :func:`branch_names` order (sum-of-squares probe — see
+    :func:`branch_sumsq` for the overflow caveat)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(jnp.isfinite(branch_sumsq(tree)), jnp.float32)
+
+
+def step_flags(loss, grads):
+    """Pre-update sentinel predicates, computed on the raw step outputs.
+
+    Returns ``(step_ok, loss_ok, grad_branch_ok, grad_norm)`` where the
+    branch array is an f32 vector (1.0 = finite) in :func:`branch_names`
+    order — flags and the global grad norm come out of the same single
+    pass over the grads. ``step_ok`` — finite loss AND finite grads — is
+    what the ``lax.cond`` update gate keys on. Param flags are computed
+    *post-update* inside :func:`update_sentinels` (params going
+    non-finite means an update corrupted state; skipping the next one
+    cannot help, so they flag but never gate).
+    """
+    import jax.numpy as jnp
+
+    loss_ok = jnp.isfinite(jnp.asarray(loss, jnp.float32))
+    g_sq = branch_sumsq(grads)
+    grad_branch_ok = jnp.asarray(jnp.isfinite(g_sq), jnp.float32)
+    grad_norm = jnp.sqrt(jnp.sum(g_sq))
+    step_ok = loss_ok & jnp.all(grad_branch_ok > 0.5)
+    return step_ok, loss_ok, grad_branch_ok, grad_norm
+
+
+def update_sentinels(
+    h_state: dict,
+    *,
+    loss,
+    step,
+    step_ok,
+    loss_ok,
+    grad_branch_ok,
+    grad_norm,
+    update_norm,
+    new_params,
+    gated: bool,
+    ema_decay: float,
+    zscore_max: float,
+    zscore_warmup: int,
+):
+    """Post-update half: fold this step into the sentinel state and emit
+    the coalesced health word. Returns ``(new_h_state, word, extras)``
+    with ``extras`` carrying the scalar sentinels (``update_ratio``,
+    ``param_norm``) for the step-metrics channel.
+
+    ``update_norm`` is computed by the caller INSIDE the
+    optimizer-application branch (‖updates‖ while the updates are live):
+    deriving the update ratio from old-vs-new params here would keep the
+    donated old param buffers alive across the update and defeat XLA's
+    in-place reuse — a real HBM + bandwidth cost on big models. The
+    param flags + norm come from one sum-of-squares pass over the NEW
+    params (an update that corrupted state flags here)."""
+    import jax.numpy as jnp
+
+    loss32 = jnp.asarray(loss, jnp.float32)
+    count = h_state["count"]
+    ema = h_state["loss_ema"]
+    sq_ema = h_state["loss_sq_ema"]
+
+    # z-score vs the EMA *before* this step enters it; suppressed during
+    # warmup and on non-finite losses (a NaN z-score would double-flag).
+    var = jnp.maximum(sq_ema - ema * ema, 0.0)
+    z_raw = (loss32 - ema) / jnp.sqrt(var + 1e-12)
+    warm = count >= zscore_warmup
+    z = jnp.where(warm & loss_ok, z_raw, 0.0)
+    z_breach = warm & loss_ok & (jnp.abs(z) > zscore_max)
+
+    # EMA advances only on finite losses (first finite loss seeds it) so
+    # one NaN step cannot poison the baseline.
+    safe = jnp.where(loss_ok, loss32, ema)
+    first = count == 0
+    new_ema = jnp.where(
+        loss_ok, jnp.where(first, safe, ema_decay * ema + (1.0 - ema_decay) * safe), ema
+    )
+    new_sq = jnp.where(
+        loss_ok,
+        jnp.where(first, safe * safe,
+                  ema_decay * sq_ema + (1.0 - ema_decay) * safe * safe),
+        sq_ema,
+    )
+    new_count = count + jnp.asarray(loss_ok, jnp.int32)
+
+    p_sq = branch_sumsq(new_params)
+    param_branch_ok = jnp.asarray(jnp.isfinite(p_sq), jnp.float32)
+    param_norm = jnp.sqrt(jnp.sum(p_sq))
+    update_ratio = jnp.asarray(update_norm, jnp.float32) / (param_norm + 1e-12)
+
+    grads_ok = jnp.all(grad_branch_ok > 0.5)
+    params_ok = jnp.all(param_branch_ok > 0.5)
+    flags = (
+        jnp.asarray(~loss_ok, jnp.float32) * FLAG_LOSS_NONFINITE
+        + jnp.asarray(~grads_ok, jnp.float32) * FLAG_GRADS_NONFINITE
+        + jnp.asarray(~params_ok, jnp.float32) * FLAG_PARAMS_NONFINITE
+        + jnp.asarray(z_breach, jnp.float32) * FLAG_LOSS_ZSCORE
+    )
+    anomalous = ~step_ok | ~params_ok
+    # `gated` is a static Python bool (the anomaly action), so the skip
+    # counter only exists as an increment when the step actually gates.
+    skip_inc = (~step_ok) if gated else jnp.zeros((), bool)
+    skipped = h_state["skipped"] + jnp.asarray(skip_inc, jnp.int32)
+    anomalies = h_state["anomalies"] + jnp.asarray(anomalous, jnp.int32)
+
+    step_i = jnp.asarray(step, jnp.int32)
+    word = jnp.concatenate([
+        jnp.stack([
+            jnp.asarray(step_i % _STEP_SPLIT, jnp.float32),
+            flags,
+            loss32,
+            z,
+            jnp.asarray(grad_norm, jnp.float32),
+            param_norm,
+            update_ratio,
+            jnp.asarray(skipped, jnp.float32),
+            jnp.asarray(anomalies, jnp.float32),
+            jnp.asarray(step_i // _STEP_SPLIT, jnp.float32),
+        ]),
+        1.0 - grad_branch_ok,   # 1.0 = branch went non-finite
+        1.0 - param_branch_ok,
+    ])
+    new_h_state = {
+        "loss_ema": new_ema,
+        "loss_sq_ema": new_sq,
+        "count": new_count,
+        "skipped": skipped,
+        "anomalies": anomalies,
+    }
+    extras = {"update_ratio": update_ratio, "param_norm": param_norm}
+    return new_h_state, word, extras
+
+
+# -- host half ---------------------------------------------------------------
+
+
+def _fetch_words(words: Sequence) -> list[np.ndarray]:
+    """One batched EXPLICIT fetch of queued health words (strict-guard
+    legal). In a multi-host run the word is a global replicated array
+    whose devices span processes — ``device_get`` rejects those, so the
+    local replica (``addressable_data``) is read instead; every process
+    holds the same value by construction."""
+    import jax
+
+    local = [
+        w.addressable_data(0)
+        if isinstance(w, jax.Array) and not w.is_fully_addressable
+        else w
+        for w in words
+    ]
+    return [np.asarray(host) for host in jax.device_get(local)]
+
+
+def decode_word(word: np.ndarray, branches: Sequence[str]) -> dict:
+    """Host-side decode of one fetched health word into a JSON-friendly
+    record (the flight-recorder entry shape)."""
+    word = np.asarray(word, np.float64)
+    flags = int(word[SLOT_FLAGS]) if math.isfinite(word[SLOT_FLAGS]) else 0
+    n = len(branches)
+    grad_bad = word[HEADER_SLOTS:HEADER_SLOTS + n]
+    param_bad = word[HEADER_SLOTS + n:HEADER_SLOTS + 2 * n]
+    return {
+        "step": int(word[SLOT_STEP]) + int(word[SLOT_STEP_HI]) * _STEP_SPLIT,
+        "flags": flags,
+        "flag_names": [name for bit, name in _FLAG_NAMES.items() if flags & bit],
+        "loss": float(word[SLOT_LOSS]),
+        "loss_zscore": float(word[SLOT_LOSS_Z]),
+        "grad_norm": float(word[SLOT_GRAD_NORM]),
+        "param_norm": float(word[SLOT_PARAM_NORM]),
+        "update_ratio": float(word[SLOT_UPDATE_RATIO]),
+        "skipped_total": int(word[SLOT_SKIPPED]),
+        "anomalies_total": int(word[SLOT_ANOMALIES]),
+        "bad_grad_branches": [b for b, v in zip(branches, grad_bad) if v > 0.5],
+        "bad_param_branches": [b for b, v in zip(branches, param_bad) if v > 0.5],
+    }
+
+
+@dataclass
+class _StepLayout:
+    branches: tuple[str, ...] = ("params",)
+
+
+class HealthMonitor:
+    """Host-side consumer of health words: lagged fetch, decode, registry
+    gauges, flight-recorder feed, and the anomaly policy. One per
+    Runtime; inert (every call an early return) when disabled."""
+
+    def __init__(
+        self,
+        config: Optional[HealthConfig] = None,
+        registry=None,
+        flight=None,
+        logger=None,
+    ) -> None:
+        self.config = config or HealthConfig()
+        self._registry = registry
+        self.flight = flight
+        self._logger = logger
+        #: label -> branch layout registered by the Module at setup.
+        self._layouts: dict[str, _StepLayout] = {}
+        #: label -> queue of (step, device word, context) awaiting their
+        #: fetch lag. Per label: two Modules in one tree must not halve
+        #: each other's effective lag or decode with each other's layout.
+        self._pending: dict[str, collections.deque] = {}
+        self.anomaly_records: list[dict] = []
+        self.last_good_step: Optional[int] = None
+        self._skipped_seen = 0
+        self._anomalies_seen = 0
+        self._zscore_breaches = 0
+        self._nonfinite_metrics = 0
+        self._halted = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    # -- wiring ------------------------------------------------------------
+
+    def register_step(self, label: str, branches: Sequence[str]) -> str:
+        """Module setup: record the health-word branch layout for a step
+        label so fetched words decode with their tree's branch names.
+
+        Returns the label to ``observe()`` under — disambiguated with a
+        ``#N`` suffix when a DIFFERENT layout already owns it (two
+        Modules wrapping the same model class must not decode each
+        other's words); idempotent for an identical re-registration."""
+        branches = tuple(branches)
+        if self._layouts.get(label, _StepLayout(branches)).branches != branches:
+            base, n = label, 2
+            while label in self._layouts and self._layouts[label].branches != branches:
+                label = f"{base}#{n}"
+                n += 1
+        self._layouts[label] = _StepLayout(branches)
+        return label
+
+    # -- the per-step path -------------------------------------------------
+
+    def observe(self, label: str, step: int, word, context: Optional[dict] = None) -> None:
+        """Queue this step's health word; fetch and process the one that
+        just became ``fetch_lag`` steps old. Called from the Module's
+        launch — the only device op is the explicit ``jax.device_get`` of
+        a word whose producing step has already retired."""
+        if not self.config.enabled:
+            return
+        queue = self._pending.setdefault(label, collections.deque())
+        queue.append((step, word, context))
+        if len(queue) > self.config.fetch_lag:
+            step, word, context = queue.popleft()
+            self._handle(label, step, _fetch_words([word])[0], context)
+
+    def drain(self, raise_on_anomaly: bool = True) -> None:
+        """Process every queued word (epoch end / teardown) with ONE
+        batched explicit fetch, so anomalies inside the final
+        ``fetch_lag`` steps are never lost."""
+        if not self.config.enabled or not any(self._pending.values()):
+            return
+        entries = [
+            (label, step, word, context)
+            for label, queue in self._pending.items()
+            for step, word, context in queue
+        ]
+        for queue in self._pending.values():
+            queue.clear()
+        words = _fetch_words([entry[2] for entry in entries])
+        error: Optional[HealthAnomalyError] = None
+        for (label, step, _word, context), host in zip(entries, words):
+            try:
+                self._handle(label, step, np.asarray(host), context)
+            except HealthAnomalyError as exc:
+                error = error or exc  # keep draining; report the first
+        if error is not None and raise_on_anomaly:
+            raise error
+
+    # -- decode + policy ---------------------------------------------------
+
+    def _handle(self, label: str, step: int, host_word: np.ndarray,
+                context: Optional[dict]) -> None:
+        layout = self._layouts.get(label, _StepLayout())
+        record = decode_word(host_word, layout.branches)
+        record["label"] = label
+        record["wall_time"] = time.time()
+        if context:
+            record.update(context)
+
+        registry = self._registry
+        if registry is not None:
+            registry.gauge("health/loss").set(record["loss"])
+            registry.gauge("health/loss_zscore").set(record["loss_zscore"])
+            registry.gauge("health/grad_norm").set(record["grad_norm"])
+            registry.gauge("health/param_norm").set(record["param_norm"])
+            registry.gauge("health/update_ratio").set(record["update_ratio"])
+            registry.gauge("health/skipped_steps").set(record["skipped_total"])
+            registry.gauge("health/anomalies").set(record["anomalies_total"])
+
+        if self.flight is not None:
+            self.flight.record(record)
+
+        flags = record["flags"]
+        if flags & _ANOMALY_MASK:
+            self._on_anomaly(record)
+        else:
+            if flags & FLAG_LOSS_ZSCORE:
+                self._zscore_breaches += 1
+                if registry is not None:
+                    registry.counter("health/zscore_breaches").inc()
+                self._warn(
+                    f"health: loss z-score breach at step {record['step']} "
+                    f"(z={record['loss_zscore']:.2f}, "
+                    f"loss={record['loss']:.4g})"
+                )
+            self.last_good_step = record["step"]
+            if registry is not None:
+                registry.gauge("health/last_good_step").set(record["step"])
+
+    def _on_anomaly(self, record: dict) -> None:
+        self._anomalies_seen += 1
+        self._skipped_seen = max(self._skipped_seen, record["skipped_total"])
+        self.anomaly_records.append(record)
+        del self.anomaly_records[:-64]  # bounded timeline
+        if self.flight is not None:
+            self.flight.note_anomaly(record)
+
+        detail = (
+            f"step {record['step']}: {'+'.join(record['flag_names'])}"
+            + (f" grads[{','.join(record['bad_grad_branches'])}]"
+               if record["bad_grad_branches"] else "")
+            + (f" params[{','.join(record['bad_param_branches'])}]"
+               if record["bad_param_branches"] else "")
+        )
+        action = self.config.action
+        if action == "skip_step":
+            self._warn(
+                f"health: anomaly at {detail} — optimizer update skipped "
+                f"({record['skipped_total']} total)"
+            )
+        elif action == "dump_and_halt":
+            if self._halted:
+                return  # one bundle, one raise — later lagged words are noise
+            self._halted = True
+            bundle = None
+            if self.flight is not None:
+                bundle = self.flight.dump(
+                    reason=f"anomaly_step{record['step']}", extra={"anomaly": record}
+                )
+            raise HealthAnomalyError(
+                f"health: anomaly at {detail} — black-box bundle "
+                f"{bundle or '(not written on this process)'}; halting.",
+                record=record, bundle=bundle,
+            )
+        else:
+            self._warn(f"health: anomaly at {detail} (action=warn, continuing)")
+
+    def note_nonfinite_metric(self, tag: str) -> None:
+        """A finalized eval metric came out non-finite (Meter/Metric
+        publish path) — a health signal the step sentinels cannot see."""
+        if not self.config.enabled:
+            return
+        self._nonfinite_metrics += 1
+        if self._registry is not None:
+            self._registry.counter("health/nonfinite_metrics").inc()
+        self._warn(f"health: published metric {tag!r} is non-finite")
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``health`` section of telemetry.json."""
+        return {
+            "enabled": self.config.enabled,
+            "action": self.config.action,
+            "fetch_lag": self.config.fetch_lag,
+            "anomalies": self._anomalies_seen,
+            "skipped_steps": self._skipped_seen,
+            "zscore_breaches": self._zscore_breaches,
+            "nonfinite_metrics": self._nonfinite_metrics,
+            "last_good_step": self.last_good_step,
+        }
+
+    def _warn(self, msg: str) -> None:
+        if self._logger is not None:
+            self._logger.warning("%s", msg)

@@ -67,6 +67,25 @@ class _Span:
         tel.spans.add(self._name, self._cat, self._t0, now - self._t0)
 
 
+def _json_safe(obj):
+    """Replace non-finite floats with their string names so
+    telemetry.json stays RFC-valid JSON (a health gauge legitimately
+    holds NaN after an anomaly; ``json.dump``'s default would emit a
+    bare ``NaN`` token that jq / JSON.parse reject). The flight
+    recorder's blackbox.json deliberately keeps raw NaN — it is read
+    back by our own Python CLI only."""
+    import math
+
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return "NaN" if math.isnan(obj) else ("Infinity" if obj > 0
+                                              else "-Infinity")
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 class Telemetry:
     """Owns the span recorder, goodput accountant, metrics registry and
     (optionally) the hang watchdog for one run."""
@@ -89,11 +108,17 @@ class Telemetry:
         self.spans = SpanRecorder(max_events=max_span_events)
         self.goodput = Goodput()
         self.registry = MetricsRegistry()
+        #: Runtime-wired (rocket_tpu.obs.flight / .health): the flight
+        #: recorder and health monitor for this run, when health sentinels
+        #: are enabled. None otherwise — every use below is guarded.
+        self.flight = None
+        self.health = None
         self.watchdog: Optional[Watchdog] = None
         if self.enabled and watchdog_secs is not None:
             self.watchdog = Watchdog(
                 watchdog_secs,
                 on_stall=self._on_stall,
+                on_escalate=self._on_stall_escalation,
                 spans=self.spans,
                 registry=self.registry,
                 logger=logger,
@@ -194,6 +219,36 @@ class Telemetry:
         self._stall_reports.append(report)
         del self._stall_reports[:-5]
 
+    def _on_stall_escalation(self, report: str) -> None:
+        """Watchdog escalation: several consecutive deadline windows with
+        no completed wave. A recoverable slow step never gets here — dump
+        the flight recorder so a genuinely wedged run leaves its black
+        box even if it is later SIGKILLed."""
+        if self.flight is not None:
+            self.flight.dump("watchdog_stall", extra={"report": report})
+
+    def exception_dump(self, exc: BaseException, **context) -> None:
+        """Forensic bundle for an exception escaping the step loop
+        (``Looper.launch``). HealthAnomalyError already dumped inside the
+        anomaly policy — dumping again here would burn a second bundle on
+        the same event."""
+        if self.flight is None:
+            return
+        from rocket_tpu.obs.health import HealthAnomalyError
+
+        if isinstance(exc, HealthAnomalyError):
+            return
+        import traceback
+
+        self.flight.dump(
+            f"exception_{type(exc).__name__}",
+            extra={
+                "exception": repr(exc),
+                "traceback": traceback.format_exc(limit=40),
+                **context,
+            },
+        )
+
     # -- snapshots ---------------------------------------------------------
 
     def suggest_out_dir(self, path: str) -> None:
@@ -211,12 +266,16 @@ class Telemetry:
         report = self.goodput.report(time.perf_counter() - self._t0)
         for cat, fraction in report["fractions"].items():
             self.registry.gauge(f"goodput/{cat}_fraction").set(fraction)
+        # Span drops surface as a first-class metric: a truncated trace
+        # must never be mistaken for a complete one.
+        self.registry.gauge("obs/spans_dropped").set(self.spans.dropped)
         return self.registry.scalars()
 
     def summary(self) -> dict:
         """The telemetry.json payload."""
         total = time.perf_counter() - self._t0
         self.registry.record_device_memory()
+        self.registry.gauge("obs/spans_dropped").set(self.spans.dropped)
         summary = {
             "version": 1,
             "goodput": self.goodput.report(total),
@@ -234,6 +293,10 @@ class Telemetry:
                 "stalls": self.watchdog.stall_count if self.watchdog else 0,
             },
         }
+        if self.health is not None and self.health.enabled:
+            summary["health"] = self.health.summary()
+        if self.flight is not None:
+            summary["blackbox"] = {"bundles": list(self.flight.dumped)}
         return summary
 
     # -- flush / close -----------------------------------------------------
@@ -259,7 +322,8 @@ class Telemetry:
             payload["watchdog"]["report_file"] = "watchdog_stalls.txt"
         tmp = os.path.join(out_dir, self.TELEMETRY_FILE + ".tmp")
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(payload, f, indent=1, sort_keys=True)
+            json.dump(_json_safe(payload), f, indent=1, sort_keys=True,
+                      allow_nan=False)
             f.write("\n")
         os.replace(tmp, os.path.join(out_dir, self.TELEMETRY_FILE))
         if self._logger is not None:
@@ -275,6 +339,13 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        if self.enabled and self.spans.dropped and self._logger is not None:
+            # One loud line at teardown: the span file is a TRUNCATED view.
+            self._logger.warning(
+                "telemetry: %d span(s) dropped (buffer bound "
+                "max_span_events=%d) — the trace file is incomplete",
+                self.spans.dropped, self.spans.max_events,
+            )
         if self.enabled and write:
             self.flush(default_dir)
         if self.watchdog is not None:

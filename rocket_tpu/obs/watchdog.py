@@ -41,7 +41,14 @@ class Watchdog:
         registry=None,
         logger=None,
         poll_s: Optional[float] = None,
+        escalate_after: int = 3,
+        on_escalate: Optional[Callable[[str], None]] = None,
     ) -> None:
+        """``escalate_after``/``on_escalate``: after this many CONSECUTIVE
+        stall windows without a single beat, the stall is treated as a
+        genuine wedge rather than one slow step and ``on_escalate`` fires
+        (once per wedge; a beat re-arms it). The Telemetry wires it to
+        the flight recorder's forensic dump."""
         if deadline_s <= 0:
             raise ValueError(f"Watchdog: deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = float(deadline_s)
@@ -58,6 +65,11 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self.stall_count = 0
         self.last_report: Optional[str] = None
+        self.escalate_after = int(escalate_after)
+        self._on_escalate = on_escalate
+        self._consecutive_stalls = 0
+        self._escalated = False
+        self.escalation_count = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -80,12 +92,17 @@ class Watchdog:
     def arm(self) -> None:
         self._last_beat = time.monotonic()
         self._armed = True
+        self._consecutive_stalls = 0
+        self._escalated = False
 
     def disarm(self) -> None:
         self._armed = False
 
     def beat(self) -> None:
         self._last_beat = time.monotonic()
+        # Progress: whatever stalled recovered — escalation re-arms.
+        self._consecutive_stalls = 0
+        self._escalated = False
 
     # -- the watcher thread ------------------------------------------------
 
@@ -105,6 +122,18 @@ class Watchdog:
             if self._on_stall is not None:
                 try:
                     self._on_stall(report)
+                except Exception:  # diagnostics must never kill the watcher
+                    pass
+            self._consecutive_stalls += 1
+            if (
+                self._on_escalate is not None
+                and not self._escalated
+                and self._consecutive_stalls >= self.escalate_after
+            ):
+                self._escalated = True
+                self.escalation_count += 1
+                try:
+                    self._on_escalate(report)
                 except Exception:  # diagnostics must never kill the watcher
                     pass
             # Count LAST: a waiter polling stall_count sees the report

@@ -284,12 +284,20 @@ def load_leaf(path: str, name: str) -> Any:
 
 #: Leaf names that may be absent from older checkpoints: the EMA shadow —
 #: enabling ema_decay mid-run must not make pre-EMA checkpoints
-#: unrestorable. Matched EXACTLY ("ema_params" or under "ema_params/"), so
-#: an unrelated leaf merely starting with the string still hard-fails.
+#: unrestorable — and the health-sentinel state (obs.health), so enabling
+#: Runtime(health=True) mid-run resumes pre-health checkpoints with the
+#: freshly initialized sentinel counters. Matched EXACTLY ("ema_params" or
+#: under "ema_params/", same for "health"), so an unrelated leaf merely
+#: starting with the string still hard-fails.
 
 
 def _is_optional_leaf(name: str) -> bool:
-    return name == "ema_params" or name.startswith("ema_params/")
+    return (
+        name == "ema_params"
+        or name.startswith("ema_params/")
+        or name == "health"
+        or name.startswith("health/")
+    )
 
 
 def load_pytree(path: str, template: Any | None = None) -> Any:
@@ -327,16 +335,19 @@ def load_pytree(path: str, template: Any | None = None) -> Any:
         name = _path_str(tpath)
         meta = index.get(name)
         if meta is None and _is_optional_leaf(name):
-            # Pre-EMA checkpoint: seed the shadow from the checkpoint's
-            # params leaf (EMA mirrors the params tree path-for-path) so
-            # enabling ema_decay mid-run resumes with EMA = restored params.
-            fallback = "params" + name[len("ema_params"):]
-            meta = index.get(fallback)
+            if name.startswith("ema_params"):
+                # Pre-EMA checkpoint: seed the shadow from the checkpoint's
+                # params leaf (EMA mirrors the params tree path-for-path) so
+                # enabling ema_decay mid-run resumes with EMA = restored
+                # params. Health-sentinel leaves have no stored analogue —
+                # their freshly initialized live values are kept.
+                fallback = "params" + name[len("ema_params"):]
+                meta = index.get(fallback)
             if not warned_optional:
                 warned_optional = True
                 logger.warning(
-                    "checkpoint at %s has no 'ema_params/*' leaves "
-                    "(pre-EMA checkpoint?) — %s", path,
+                    "checkpoint at %s predates the %r leaves — %s", path,
+                    name.split("/", 1)[0],
                     "seeding the EMA shadow from the checkpoint's params"
                     if meta is not None else "keeping the live values",
                 )

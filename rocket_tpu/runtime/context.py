@@ -304,6 +304,31 @@ class Runtime:
         value implies ``telemetry=True`` when ``telemetry`` is left
         unset (the env var does not — it only arms the watchdog on runs
         that opted into telemetry).
+    health:
+        Opt into training-health sentinels (``rocket_tpu.obs.health``):
+        a health word — per-branch non-finite flags for loss/grads/
+        params, grad/param norms, update ratio, loss z-score vs an
+        on-device EMA — computed INSIDE the compiled train step and
+        fetched asynchronously ``health_fetch_lag`` steps behind, plus
+        the flight recorder's black-box ring and forensic crash dumps.
+        None (default) reads ``ROCKET_TPU_HEALTH`` (``1`` enables with
+        the default action; ``warn``/``skip_step``/``dump_and_halt``
+        enables with that action). An explicit ``health=True`` implies
+        ``telemetry=True`` when ``telemetry`` is left unset.
+    anomaly_action:
+        What a detected anomaly (non-finite loss/grads/params) does:
+        ``"warn"`` (log + count), ``"skip_step"`` (the compiled step
+        gates the optimizer update with ``lax.cond`` so state stays
+        finite; the skip is counted), or ``"dump_and_halt"`` (gate the
+        update, write a ``runs/<project>/blackbox/`` forensic bundle and
+        raise ``HealthAnomalyError``).
+    blackbox_steps:
+        Flight-recorder ring size — the last N steps' sentinel snapshots
+        kept for the forensic bundle.
+    health_fetch_lag:
+        How many steps behind the health word is fetched; by then the
+        producing step has retired, so the explicit device_get cannot
+        stall the dispatch pipeline (sync-free under strict mode).
     """
 
     #: Name of the batch-sharded mesh axis group. Parallel schemes that shard
@@ -336,6 +361,10 @@ class Runtime:
         telemetry: Optional[bool] = None,
         telemetry_dir: Optional[str] = None,
         watchdog_secs: Optional[float] = None,
+        health: Optional[bool] = None,
+        anomaly_action: Optional[str] = None,
+        blackbox_steps: int = 256,
+        health_fetch_lag: int = 2,
     ) -> None:
         _enable_compilation_cache()
         _maybe_initialize_distributed()
@@ -415,12 +444,33 @@ class Runtime:
         # into ONE object and teardown has one flush point. Default: off;
         # ROCKET_TPU_TELEMETRY=1 opts a run in without touching code.
         from rocket_tpu.obs import Telemetry
+        from rocket_tpu.obs.health import (
+            ANOMALY_ACTIONS,
+            HealthConfig,
+            HealthMonitor,
+        )
+
+        # Training-health sentinels + flight recorder. Default: off;
+        # ROCKET_TPU_HEALTH opts a run in without touching code — "1"
+        # enables the default action, an action name ("warn" |
+        # "skip_step" | "dump_and_halt") enables AND selects it. An
+        # explicit health= / anomaly_action= argument wins over the env.
+        env_health = os.environ.get("ROCKET_TPU_HEALTH", "").strip().lower()
+        if health is None:
+            health = env_health in ("1", "true", "yes", "on") or (
+                env_health in ANOMALY_ACTIONS
+            )
+        if anomaly_action is None:
+            anomaly_action = (
+                env_health if env_health in ANOMALY_ACTIONS else "warn"
+            )
 
         if telemetry is None:
-            if watchdog_secs is not None:
-                # An explicit watchdog_secs= is an explicit ask for hang
-                # protection; the watchdog lives inside telemetry, so the
-                # ask implies the subsystem rather than silently no-opping.
+            if watchdog_secs is not None or health:
+                # An explicit watchdog_secs= or health=True is an explicit
+                # ask for hang protection / health forensics; both live
+                # inside telemetry, so the ask implies the subsystem
+                # rather than silently no-opping.
                 telemetry = True
             else:
                 telemetry = os.environ.get(
@@ -448,6 +498,34 @@ class Runtime:
             logger=self.get_logger("obs"),
         )
         self.strict.telemetry = self.telemetry
+
+        # Health monitor + flight recorder: the monitor always exists (an
+        # inert object when disabled — capsules check `runtime.health
+        # .enabled` with no getattr dance); the flight recorder only when
+        # health is on (it is the black box the health policy dumps into).
+        health_cfg = HealthConfig(
+            enabled=bool(health),
+            action=anomaly_action,
+            fetch_lag=health_fetch_lag,
+        )
+        self.flight = None
+        if health_cfg.enabled:
+            from rocket_tpu.obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                max_steps=blackbox_steps,
+                telemetry=self.telemetry,
+                runtime=self,
+                logger=self.get_logger("obs"),
+            )
+        self.health = HealthMonitor(
+            health_cfg,
+            registry=self.telemetry.registry,
+            flight=self.flight,
+            logger=self.get_logger("obs"),
+        )
+        self.telemetry.flight = self.flight
+        self.telemetry.health = self.health
         self.telemetry.start()
 
         self._warned_replicated_batch = False
@@ -701,6 +779,13 @@ class Runtime:
                 )
         self.trackers.clear()
         self.strict.deactivate()
+        # Health words still inside their fetch lag are decoded now so a
+        # last-steps anomaly is counted (and dumped) before the telemetry
+        # record freezes; teardown never raises on one — the run is over.
+        try:
+            self.health.drain(raise_on_anomaly=False)
+        except Exception as exc:  # noqa: BLE001 — teardown must complete
+            logger.warning("health drain failed at teardown: %r", exc)
         self.telemetry.close(
             default_dir=os.path.join(self.project_dir, "runs", "telemetry"),
             write=self.is_main_process,

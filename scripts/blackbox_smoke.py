@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Blackbox smoke gate: an injected-NaN batch must leave a usable trail.
+
+Two legs over the same poisoned dataset (one batch of all-NaN images),
+both under ``Runtime(strict=True)``:
+
+* ``anomaly_action="skip_step"`` — the run finishes, every final param is
+  finite, and the skip is counted in the health summary;
+* ``anomaly_action="dump_and_halt"`` — the run halts with
+  ``HealthAnomalyError`` and a complete ``blackbox/`` bundle exists
+  (manifest + anomaly timeline + emergency checkpoint) that
+  ``python -m rocket_tpu.obs blackbox`` renders.
+
+Exits non-zero on the first violated invariant (wired into
+scripts/check.sh and CI).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import rocket_tpu as rt  # noqa: E402
+from rocket_tpu import optim  # noqa: E402
+from rocket_tpu.models.mlp import MLP  # noqa: E402
+from rocket_tpu.obs import HealthAnomalyError  # noqa: E402
+
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+def check(condition, message):
+    if not condition:
+        print(f"blackbox smoke FAILED: {message}", file=sys.stderr)
+        sys.exit(1)
+
+
+def poisoned_data(n=128, nan_from=64, nan_to=72):
+    rng = np.random.default_rng(0)
+    data = []
+    for i in range(n):
+        image = rng.normal(size=8).astype(np.float32)
+        if nan_from <= i < nan_to:
+            image[:] = np.nan  # one poisoned batch (batch_size=32 -> batch 2)
+        data.append({"image": image, "label": np.int32(i % 4)})
+    return data
+
+
+class GrabParams(rt.Capsule):
+    """Keeps a reference to the module's latest params so their
+    finiteness can be asserted after DESTROY tears the tree down."""
+
+    def __init__(self, module):
+        super().__init__(priority=10)
+        self._module = module
+        self.params = None
+
+    def launch(self, attrs=None):
+        if self._module.state is not None:
+            self.params = self._module.state["params"]
+
+
+def run(workdir, action, with_checkpointer):
+    runtime = rt.Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=workdir,
+        strict=True, health=True, anomaly_action=action,
+    )
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    grab = GrabParams(module)
+    capsules = [rt.Dataset(poisoned_data(), batch_size=32), module, grab]
+    if with_checkpointer:
+        capsules.append(
+            rt.Checkpointer(output_dir=os.path.join(workdir, "ckpt"),
+                            save_every=10_000)
+        )
+    launcher = rt.Launcher(
+        [rt.Looper(capsules, tag="train", progress=False)],
+        num_epochs=2, runtime=runtime,
+    )
+    return runtime, grab, launcher
+
+
+def _workdir(prefix):
+    # Under the repo's (gitignored) runs/ — NOT the system tmpdir — so a
+    # failing CI run's telemetry + blackbox bundles land inside the
+    # workspace where the runs/** artifact-upload step can find them.
+    repo_runs = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "runs"
+    )
+    os.makedirs(repo_runs, exist_ok=True)
+    return tempfile.mkdtemp(prefix=prefix, dir=repo_runs)
+
+
+def main() -> None:
+    # Leg 1: skip_step — the poisoned batch is survived, state stays finite.
+    workdir = _workdir("blackbox_skip_")
+    runtime, grab, launcher = run(workdir, "skip_step", False)
+    launcher.launch()
+    summary = runtime.health.summary()
+    check(summary["skipped_steps"] >= 1, f"no skip counted: {summary}")
+    check(summary["anomalies"] >= 1, f"no anomaly counted: {summary}")
+    host_params = jax.device_get(grab.params)
+    check(
+        all(np.isfinite(leaf).all() for leaf in jax.tree.leaves(host_params)),
+        "final params contain non-finite values despite skip_step",
+    )
+
+    # Leg 2: dump_and_halt — the run halts and leaves a renderable bundle.
+    workdir = _workdir("blackbox_halt_")
+    runtime, grab, launcher = run(workdir, "dump_and_halt", True)
+    halted = False
+    try:
+        launcher.launch()
+    except HealthAnomalyError as exc:
+        halted = True
+        check(exc.bundle is not None, "halt raised without a bundle path")
+    check(halted, "dump_and_halt did not halt on the injected NaN")
+
+    bundles = glob.glob(
+        os.path.join(workdir, "runs", "telemetry", "blackbox", "*")
+    )
+    check(len(bundles) == 1, f"expected exactly one bundle, got {bundles}")
+    bundle = bundles[0]
+    with open(os.path.join(bundle, "blackbox.json")) as f:
+        manifest = json.load(f)
+    check(manifest["reason"].startswith("anomaly_step"),
+          f"unexpected dump reason {manifest['reason']!r}")
+    check(manifest["last_good_step"] is not None, "no last-good step recorded")
+    check(len(manifest["anomalies"]) >= 1, "empty anomaly timeline")
+    check(manifest["sentinel_history"], "empty sentinel history")
+    check(
+        os.path.exists(os.path.join(bundle, "checkpoint", "model_0",
+                                    "index.json")),
+        "emergency checkpoint missing from the bundle",
+    )
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "blackbox", bundle],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 0,
+          f"blackbox CLI failed: {proc.stderr[-300:]}")
+    check("last good step" in proc.stdout and "anomaly timeline" in proc.stdout,
+          f"blackbox CLI output incomplete:\n{proc.stdout}")
+
+    print(
+        "blackbox smoke OK: skip_step survived the NaN batch "
+        f"({summary['skipped_steps']} skip(s)); dump_and_halt wrote + "
+        f"rendered {os.path.basename(bundle)}"
+    )
+
+
+if __name__ == "__main__":
+    main()

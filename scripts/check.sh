@@ -30,12 +30,19 @@ echo "== precision audit (dtype-flow self-gate + numerics budgets) =="
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis prec \
     --budgets tests/fixtures/budgets/prec
 
-echo "== obs smoke (telemetry + strict step path) =="
-# Tier-1 example run with telemetry on: telemetry.json must exist and
-# parse, goodput categories must sum to wall-clock, the span file must be
-# valid Chrome-trace JSON, and the strict transfer guard stays green with
-# instrumentation active.
+echo "== obs smoke (telemetry + health sentinels + strict step path) =="
+# Tier-1 example run with telemetry AND health sentinels on:
+# telemetry.json must exist and parse, goodput categories must sum to
+# wall-clock, the span file must be valid Chrome-trace JSON, the health
+# gauges must be populated with zero anomalies, and the strict transfer
+# guard stays green with all instrumentation active.
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+echo "== blackbox smoke (injected NaN -> skip_step / forensic bundle) =="
+# A poisoned batch under anomaly_action=skip_step must finish with finite
+# params and a counted skip; under dump_and_halt it must halt and leave a
+# complete runs/**/blackbox/ bundle the post-mortem CLI renders.
+JAX_PLATFORMS=cpu python scripts/blackbox_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail

@@ -51,7 +51,14 @@ def check(condition, message):
 
 
 def main() -> None:
-    workdir = tempfile.mkdtemp(prefix="rocket_obs_smoke_")
+    # Workdir under the repo's (gitignored) runs/ — NOT the system tmpdir —
+    # so a failing CI run's telemetry lands inside the workspace where the
+    # runs/** artifact-upload step can find it.
+    repo_runs = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "runs"
+    )
+    os.makedirs(repo_runs, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="obs_smoke_", dir=repo_runs)
     runs_dir = os.path.join(workdir, "runs")
     rng = np.random.default_rng(0)
     data = [
@@ -62,9 +69,13 @@ def main() -> None:
     # strict=True: the run-wide D2H guard + per-wave full transfer guard
     # stay green with the obs instrumentation active (the self-gate half
     # of the acceptance criteria; rocketlint covers the static half).
+    # health=True: the sentinel-instrumented step path — health word
+    # computed in-jit, fetched lagged+explicit — must ALSO stay sync-free
+    # under the guards.
     runtime = rt.Runtime(
         mesh_shape={"data": 8}, seed=0, project_dir=workdir,
         strict=True, telemetry=True, watchdog_secs=120.0,
+        health=True, anomaly_action="skip_step",
     )
     model = MLP(in_features=8, num_classes=4, hidden=(16,))
     module = rt.Module(
@@ -113,12 +124,28 @@ def main() -> None:
     check({"step", "compile", "data_wait", "flush"} <= cats,
           f"span categories incomplete: {sorted(cats)}")
 
+    # Health sentinels ran on every step of this clean run: the decoded
+    # gauges are present, nothing anomalous, nothing skipped.
+    health = record.get("health")
+    check(health is not None, "no health section in telemetry.json")
+    check(health["anomalies"] == 0,
+          f"clean run reported {health['anomalies']} anomalies")
+    check(health["skipped_steps"] == 0,
+          f"clean run skipped {health['skipped_steps']} steps")
+    check(health["last_good_step"] is not None, "no health word decoded")
+    gauges = record["metrics"]["gauges"]
+    for key in ("health/grad_norm", "health/update_ratio",
+                "health/last_good_step"):
+        check(key in gauges, f"{key} missing from the registry snapshot")
+
     # obs/* scalars landed in the tracker backend stream.
     jsonl = os.path.join(runs_dir, "smoke.jsonl")
     with open(jsonl) as f:
         lines = [json.loads(line) for line in f if line.strip()]
     check(any(k.startswith("obs/") for rec in lines for k in rec),
           "no obs/* scalars in the tracker stream")
+    check(any(k.startswith("health/") for rec in lines for k in rec),
+          "no health/* scalars in the tracker stream")
 
     # The report CLI renders both files.
     for path in (telemetry_path, spans_path):
@@ -133,7 +160,8 @@ def main() -> None:
         "obs smoke OK: "
         f"goodput step={goodput['fractions']['step']:.1%} "
         f"compile={goodput['fractions']['compile']:.1%}, "
-        f"{len(complete)} spans, strict guards green"
+        f"{len(complete)} spans, health sentinels green "
+        f"(last good step {health['last_good_step']}), strict guards green"
     )
 
 

@@ -207,6 +207,31 @@ def test_shard_audit_summary_missing_budgets_is_none(tmp_path):
     assert "shard_audit" not in json.loads(path.read_text())
 
 
+def test_write_detail_carries_health_sentinel_record(tmp_path):
+    """BENCH_DETAIL.json carries the measured health-sentinel overhead
+    (steps/sec with the in-step sentinels + lax.cond gate on vs off) when
+    main() hands a probe record over — and simply omits the section when
+    the probe was skipped or failed."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    probe = {
+        "steps_per_sec_baseline": 150.0,
+        "steps_per_sec_with_sentinels": 148.5,
+        "overhead_frac": 0.01,
+        "action": "skip_step",
+        "anomalies": 0,
+        "skipped_steps": 0,
+        "config": "mlp",
+    }
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path),
+                       health=probe)
+    record = json.loads(path.read_text())["health_sentinels"]
+    assert record["overhead_frac"] == 0.01
+    assert record["anomalies"] == 0
+
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    assert "health_sentinels" not in json.loads(path.read_text())
+
+
 def test_write_detail_partial_run_keeps_gpt2_headline(tmp_path):
     """The merged record's headline must stay gpt2 after a debug run of
     a different config."""

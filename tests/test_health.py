@@ -1,0 +1,584 @@
+"""rocket_tpu.obs.health + obs.flight: in-step health sentinels, the
+anomaly policy (warn / skip_step / dump_and_halt), the lagged host fetch,
+and the black-box flight recorder with forensic bundles."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.obs import (
+    HealthAnomalyError,
+    Telemetry,
+    Watchdog,
+    flight as flight_lib,
+    health as health_lib,
+)
+from rocket_tpu.obs.registry import MetricsRegistry
+from rocket_tpu.runtime.context import Runtime
+
+
+# -- device half: word compute / decode -------------------------------------
+
+
+def test_health_word_roundtrip_clean_and_nan():
+    params = {"dense": {"w": jnp.ones((4, 4))}, "head": {"w": jnp.ones((4,))}}
+    branches = health_lib.branch_names(params)
+    assert branches == ("dense", "head")
+
+    def one_step(loss, grads, new_params):
+        h = health_lib.init_state()
+        step_ok, loss_ok, g_ok, grad_norm = health_lib.step_flags(loss, grads)
+        h2, word, extras = health_lib.update_sentinels(
+            h, loss=loss, step=jnp.zeros((), jnp.int32), step_ok=step_ok,
+            loss_ok=loss_ok, grad_branch_ok=g_ok, grad_norm=grad_norm,
+            update_norm=jnp.zeros((), jnp.float32), new_params=new_params,
+            gated=True, ema_decay=0.98, zscore_max=8.0, zscore_warmup=20,
+        )
+        return word
+
+    clean = jax.jit(one_step)(jnp.float32(1.5), params, params)
+    rec = health_lib.decode_word(np.asarray(clean), branches)
+    assert rec["flags"] == 0 and rec["flag_names"] == []
+    assert rec["loss"] == pytest.approx(1.5)
+    assert rec["skipped_total"] == 0 and rec["anomalies_total"] == 0
+    assert rec["update_ratio"] == pytest.approx(0.0)
+
+    bad_grads = {"dense": {"w": jnp.full((4, 4), jnp.nan)},
+                 "head": {"w": jnp.ones((4,))}}
+    bad = jax.jit(one_step)(jnp.float32(jnp.nan), bad_grads, params)
+    rec = health_lib.decode_word(np.asarray(bad), branches)
+    assert set(rec["flag_names"]) == {"loss_nonfinite", "grads_nonfinite"}
+    assert rec["bad_grad_branches"] == ["dense"]
+    assert rec["bad_param_branches"] == []
+    assert rec["skipped_total"] == 1  # gated=True counts the skip on device
+    assert rec["anomalies_total"] == 1
+
+
+def test_nan_loss_does_not_poison_the_ema():
+    h = health_lib.init_state()
+    kwargs = dict(
+        grad_branch_ok=jnp.ones((1,)), grad_norm=jnp.float32(1.0),
+        update_norm=jnp.float32(0.0), new_params={"w": jnp.ones(2)},
+        gated=False, ema_decay=0.9, zscore_max=8.0, zscore_warmup=2,
+    )
+    for step, loss in enumerate([1.0, 1.0, float("nan"), 1.0]):
+        loss = jnp.float32(loss)
+        ok = jnp.isfinite(loss)
+        h, word, _ = health_lib.update_sentinels(
+            h, loss=loss, step=jnp.int32(step),
+            step_ok=ok, loss_ok=ok, **kwargs,
+        )
+    assert float(h["loss_ema"]) == pytest.approx(1.0)
+    assert int(h["count"]) == 3  # the NaN step did not advance the EMA
+
+
+# -- host half: monitor lag + policy ----------------------------------------
+
+
+def _word(step, flags=0.0, n_branches=1, skipped=0, anomalies=0):
+    word = np.zeros(health_lib.word_length(n_branches), np.float32)
+    word[health_lib.SLOT_STEP] = step
+    word[health_lib.SLOT_FLAGS] = flags
+    word[health_lib.SLOT_SKIPPED] = skipped
+    word[health_lib.SLOT_ANOMALIES] = anomalies
+    return word
+
+
+def test_monitor_fetches_lagged_and_counts_anomalies():
+    reg = MetricsRegistry()
+    mon = health_lib.HealthMonitor(
+        health_lib.HealthConfig(enabled=True, action="warn", fetch_lag=2),
+        registry=reg,
+    )
+    mon.register_step("train_step[MLP]", ("params",))
+    mon.observe("train_step[MLP]", 0, _word(0))
+    mon.observe("train_step[MLP]", 1, _word(1))
+    assert mon.last_good_step is None  # both still inside the fetch lag
+    mon.observe(
+        "train_step[MLP]", 2,
+        _word(2, flags=health_lib.FLAG_LOSS_NONFINITE, anomalies=1),
+    )
+    assert mon.last_good_step == 0  # word 0 just crossed the lag
+    mon.drain()
+    assert mon.last_good_step == 1  # step 2 is anomalous, 1 is the last good
+    assert mon.summary()["anomalies"] == 1
+    assert reg.snapshot()["gauges"]["health/last_good_step"] == 1.0
+
+
+def test_monitor_dump_and_halt_raises_once():
+    mon = health_lib.HealthMonitor(
+        health_lib.HealthConfig(enabled=True, action="dump_and_halt",
+                                fetch_lag=1),
+    )
+    mon.observe("s", 0, _word(0))
+    mon.observe(
+        "s", 1, _word(1, flags=health_lib.FLAG_GRADS_NONFINITE, anomalies=1),
+    )  # word 1 is still inside the fetch lag here
+    with pytest.raises(HealthAnomalyError):
+        # Observing word 2 fetches the lagged anomalous word 1.
+        mon.observe(
+            "s", 2, _word(2, flags=health_lib.FLAG_GRADS_NONFINITE,
+                          anomalies=2),
+        )
+    # A second anomalous word after the halt is noise, not a second raise.
+    mon.drain()
+
+
+def test_register_step_disambiguates_conflicting_layouts():
+    """Two Modules wrapping the same model class must not decode each
+    other's words: a conflicting layout under an existing label gets a
+    #N suffix (and its own lag queue); identical re-registration is
+    idempotent."""
+    mon = health_lib.HealthMonitor(
+        health_lib.HealthConfig(enabled=True, fetch_lag=2)
+    )
+    first = mon.register_step("train_step[MLP]", ("enc", "head"))
+    again = mon.register_step("train_step[MLP]", ("enc", "head"))
+    other = mon.register_step("train_step[MLP]", ("torso", "policy"))
+    assert first == again == "train_step[MLP]"
+    assert other == "train_step[MLP]#2"
+    # Distinct labels keep their full fetch lag: two interleaved streams,
+    # neither fetches until ITS OWN queue exceeds the lag.
+    mon.observe(first, 0, _word(0))
+    mon.observe(other, 0, _word(0))
+    mon.observe(first, 1, _word(1))
+    mon.observe(other, 1, _word(1))
+    assert mon.last_good_step is None
+    mon.observe(first, 2, _word(2))
+    assert mon.last_good_step == 0
+
+
+def test_disabled_monitor_is_inert():
+    mon = health_lib.HealthMonitor(health_lib.HealthConfig(enabled=False))
+    mon.observe("s", 0, object())  # never touched, never fetched
+    mon.drain()
+    mon.note_nonfinite_metric("acc")
+    assert mon.summary()["enabled"] is False
+
+
+def test_invalid_anomaly_action_rejected(tmp_path):
+    with pytest.raises(ValueError, match="anomaly_action"):
+        Runtime(seed=0, project_dir=str(tmp_path), health=True,
+                anomaly_action="explode")
+
+
+def test_env_var_enables_health_with_action(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROCKET_TPU_HEALTH", "skip_step")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    try:
+        assert runtime.health.enabled
+        assert runtime.health.config.action == "skip_step"
+        assert runtime.telemetry.enabled  # health implies telemetry
+        assert runtime.flight is not None
+    finally:
+        runtime.end_training()
+
+
+def test_telemetry_json_stays_strict_json_with_nan_gauges(tmp_path):
+    """An anomaly legitimately leaves NaN in the health gauges;
+    telemetry.json must still be RFC-valid JSON (string-encoded), not a
+    bare NaN token that jq / JSON.parse reject."""
+    tel = Telemetry(enabled=True, out_dir=str(tmp_path))
+    tel.registry.gauge("health/loss").set(float("nan"))
+    tel.registry.gauge("health/grad_norm").set(float("inf"))
+    out = tel.flush()
+    raw = open(os.path.join(out, "telemetry.json")).read()
+
+    def no_bare_constants(name):
+        raise AssertionError(f"bare {name} token in telemetry.json")
+
+    doc = json.loads(raw, parse_constant=no_bare_constants)
+    assert doc["metrics"]["gauges"]["health/loss"] == "NaN"
+    assert doc["metrics"]["gauges"]["health/grad_norm"] == "Infinity"
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_tracks_last_good():
+    rec = flight_lib.FlightRecorder(max_steps=3)
+    for step in range(6):
+        rec.record({"step": step, "flag_names": []})
+    rec.record({"step": 6, "flag_names": ["loss_nonfinite"]})
+    assert len(rec) == 3
+    assert rec.last_good_step == 5
+
+
+def test_flight_dump_writes_manifest_and_respects_budget(tmp_path):
+    tel = Telemetry(enabled=True, out_dir=str(tmp_path))
+    rec = flight_lib.FlightRecorder(max_steps=8, telemetry=tel, max_dumps=2)
+    rec.record({"step": 0, "flag_names": []})
+    rec.note_anomaly({"step": 1, "flag_names": ["loss_nonfinite"]})
+    first = rec.dump("anomaly_step1", extra={"note": "test"})
+    again = rec.dump("anomaly_step1")  # same reason -> deduped directory
+    assert first != again and os.path.isdir(first) and os.path.isdir(again)
+    assert rec.dump("third") is None  # budget of 2 spent
+    manifest = json.load(open(os.path.join(first, "blackbox.json")))
+    assert manifest["reason"] == "anomaly_step1"
+    assert manifest["last_good_step"] == 0
+    assert manifest["anomalies"][0]["step"] == 1
+    assert manifest["extra"]["note"] == "test"
+    assert manifest["checkpoint"] is None  # no Checkpointer attached
+
+
+def test_flight_dump_gated_to_main_process(tmp_path):
+    """Only the main process writes bundles — the same gate the (slow)
+    two-process test asserts end-to-end via per-rank project dirs."""
+
+    class FakeRuntime:
+        project_dir = None
+        is_main_process = False
+        process_index = 1
+        process_count = 2
+
+        def rng_state_dict(self):
+            return {"seed": 0, "key_counter": 0}
+
+    fake = FakeRuntime()
+    fake.project_dir = str(tmp_path)
+    rec = flight_lib.FlightRecorder(max_steps=4, runtime=fake)
+    rec.record({"step": 0, "flag_names": []})
+    assert rec.dump("anomaly") is None
+    assert not os.path.isdir(tmp_path / "runs" / "telemetry" / "blackbox")
+    fake.is_main_process = True
+    bundle = rec.dump("anomaly")
+    assert bundle is not None and os.path.isdir(bundle)
+
+
+# -- watchdog escalation ----------------------------------------------------
+
+
+def test_watchdog_escalates_after_consecutive_stalls():
+    escalations = []
+    dog = Watchdog(0.08, poll_s=0.02, escalate_after=2,
+                   on_escalate=escalations.append)
+    dog.start()
+    try:
+        dog.arm()
+        deadline = time.time() + 5.0
+        while not escalations and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        dog.stop()
+    assert len(escalations) == 1  # fired exactly once per wedge
+    assert dog.stall_count >= 2
+    assert dog.escalation_count == 1
+
+
+def test_watchdog_beat_resets_escalation():
+    escalations = []
+    dog = Watchdog(0.1, poll_s=0.02, escalate_after=3,
+                   on_escalate=escalations.append)
+    dog.start()
+    try:
+        dog.arm()
+        for _ in range(8):  # two stall windows' worth, beating in between
+            time.sleep(0.06)
+            dog.beat()
+    finally:
+        dog.stop()
+    assert escalations == []
+
+
+# -- end-to-end -------------------------------------------------------------
+
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+def _poisoned_data(n=128, nan_from=64, nan_to=72):
+    """One all-NaN batch (batch_size=32 -> batch index 2)."""
+    rng = np.random.default_rng(0)
+    data = []
+    for i in range(n):
+        image = rng.normal(size=8).astype(np.float32)
+        if nan_from <= i < nan_to:
+            image[:] = np.nan
+        data.append({"image": image, "label": np.int32(i % 4)})
+    return data
+
+
+class GrabParams(rt.Capsule):
+    """Holds the latest params reference so finiteness is checkable after
+    DESTROY tears the module down."""
+
+    def __init__(self, module):
+        super().__init__(priority=10)
+        self._module = module
+        self.params = None
+
+    def launch(self, attrs=None):
+        if self._module.state is not None:
+            self.params = self._module.state["params"]
+
+
+def _tree(runtime, tmp_path, module_kwargs=None, extra=(),
+          num_epochs=2, data=None):
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+        **(module_kwargs or {}),
+    )
+    grab = GrabParams(module)
+    launcher = rt.Launcher(
+        [rt.Looper(
+            [rt.Dataset(data if data is not None else _poisoned_data(),
+                        batch_size=32), module, grab,
+             *extra],
+            tag="train", progress=False,
+        )],
+        num_epochs=num_epochs, runtime=runtime,
+    )
+    return launcher, module, grab
+
+
+def test_skip_step_survives_nan_batch_with_finite_params(tmp_path):
+    """Acceptance: an injected-NaN batch under skip_step finishes the run
+    with finite params and a counted skip — under strict mode, proving
+    the sentinel path adds no implicit transfer."""
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        strict=True, health=True, anomaly_action="skip_step",
+    )
+    launcher, module, grab = _tree(runtime, tmp_path)
+    launcher.launch()
+
+    summary = runtime.health.summary()
+    assert summary["anomalies"] == 2       # one poisoned batch per epoch
+    assert summary["skipped_steps"] == 2
+    host = jax.device_get(grab.params)
+    assert all(np.isfinite(leaf).all() for leaf in jax.tree.leaves(host))
+    # The registry carries the decoded sentinels for the dashboard.
+    gauges = runtime.telemetry.registry.snapshot()["gauges"]
+    assert gauges["health/skipped_steps"] == 2.0
+    assert gauges["health/anomalies"] == 2.0
+
+
+def test_skip_step_gates_accumulation_window(tmp_path):
+    """With gradient accumulation, the poisoned microbatch drops out of
+    the accumulator — the boundary update still applies and params stay
+    finite."""
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        gradient_accumulation_steps=2, health=True,
+        anomaly_action="skip_step",
+    )
+    launcher, module, grab = _tree(runtime, tmp_path, num_epochs=1)
+    launcher.launch()
+    assert runtime.health.summary()["skipped_steps"] == 1
+    host = jax.device_get(grab.params)
+    assert all(np.isfinite(leaf).all() for leaf in jax.tree.leaves(host))
+
+
+def test_warn_action_does_not_gate(tmp_path):
+    """warn: the anomaly is counted but the update applies — params go
+    non-finite (exactly why skip_step exists)."""
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        health=True, anomaly_action="warn",
+    )
+    launcher, module, grab = _tree(runtime, tmp_path, num_epochs=1)
+    launcher.launch()
+    summary = runtime.health.summary()
+    assert summary["anomalies"] >= 1
+    assert summary["skipped_steps"] == 0
+    host = jax.device_get(grab.params)
+    assert not all(np.isfinite(leaf).all() for leaf in jax.tree.leaves(host))
+
+
+def test_dump_and_halt_writes_renderable_bundle(tmp_path):
+    """Acceptance: dump_and_halt produces a complete blackbox bundle that
+    the post-mortem CLI renders (last-good step + anomaly timeline), with
+    the emergency checkpoint riding along."""
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        strict=True, health=True, anomaly_action="dump_and_halt",
+    )
+    launcher, module, grab = _tree(
+        runtime, tmp_path,
+        extra=(rt.Checkpointer(output_dir=str(tmp_path / "ckpt"),
+                               save_every=10_000),),
+    )
+    with pytest.raises(HealthAnomalyError) as excinfo:
+        launcher.launch()
+    bundle = excinfo.value.bundle
+    assert bundle is not None and os.path.isdir(bundle)
+    assert glob.glob(
+        str(tmp_path / "runs" / "telemetry" / "blackbox" / "*")
+    ) == [bundle]
+
+    manifest = json.load(open(os.path.join(bundle, "blackbox.json")))
+    assert manifest["reason"].startswith("anomaly_step")
+    assert manifest["last_good_step"] == 1  # poisoned batch is step 2
+    assert [rec["step"] for rec in manifest["anomalies"]] == [2]
+    assert manifest["anomalies"][0]["flag_names"] == [
+        "loss_nonfinite", "grads_nonfinite"
+    ]
+    assert manifest["sentinel_history"]
+    assert manifest["spans_tail"]
+    assert manifest["rng"]["seed"] == 0
+    # Emergency checkpoint: complete and (single-host) resumable.
+    ckpt_index = os.path.join(bundle, "checkpoint", "model_0", "index.json")
+    assert os.path.exists(ckpt_index)
+    index = json.load(open(ckpt_index))
+    assert any(name == "step" for name in index)
+    # The gated update kept the dumped state finite.
+    from rocket_tpu.runtime import checkpoint_io
+
+    flat = checkpoint_io.load_pytree(os.path.dirname(ckpt_index))
+    for name, value in flat.items():
+        if name.startswith("params/"):
+            assert np.isfinite(value).all(), name
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "blackbox", bundle],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "last good step: 1" in proc.stdout
+    assert "anomaly timeline" in proc.stdout
+    assert "loss_nonfinite+grads_nonfinite" in proc.stdout
+    assert "emergency checkpoint" in proc.stdout
+
+    # telemetry.json (written by end_training in the Launcher's finally)
+    # records the health summary and the bundle path.
+    record = json.load(
+        open(tmp_path / "runs" / "telemetry" / "telemetry.json")
+    )
+    assert record["health"]["anomalies"] == 1
+    assert record["blackbox"]["bundles"] == [bundle]
+
+
+def test_watchdog_escalation_dumps_flight_recorder(tmp_path):
+    """Acceptance: a genuinely wedged step (consecutive stall windows, no
+    beat) escalates from stack dumps to a full black-box bundle carrying
+    the watchdog report."""
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        health=True, watchdog_secs=0.15,
+    )
+    runtime.telemetry.watchdog._poll_s = 0.02  # fast test cadence
+
+    class Stall(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+            self.done = False
+
+        def launch(self, attrs=None):
+            if not self.done:
+                self.done = True
+                dog = self._runtime.telemetry.watchdog
+                deadline = time.time() + 10.0
+                while dog.escalation_count == 0 and time.time() < deadline:
+                    time.sleep(0.02)
+
+    data = [{"x": np.float32(i)} for i in range(16)]
+    rt.Launcher(
+        [rt.Looper([rt.Dataset(data, batch_size=8, fuse_gather=False),
+                    Stall()], tag="train", progress=False)],
+        num_epochs=1, runtime=runtime,
+    ).launch()
+    bundles = glob.glob(
+        str(tmp_path / "runs" / "telemetry" / "blackbox" / "*")
+    )
+    assert len(bundles) == 1 and "watchdog_stall" in bundles[0]
+    manifest = json.load(open(os.path.join(bundles[0], "blackbox.json")))
+    assert "no step completed" in manifest["extra"]["report"]
+
+
+def test_loop_exception_dumps_forensics(tmp_path):
+    """An uncaught exception escaping the step loop leaves a black-box
+    bundle with the exception context before propagating."""
+
+    class Boom(rt.Capsule):
+        def __init__(self):
+            super().__init__(priority=500)
+            self.count = 0
+
+        def launch(self, attrs=None):
+            self.count += 1
+            if self.count == 3:
+                raise RuntimeError("kaboom")
+
+    runtime = Runtime(
+        mesh_shape={"data": 8}, seed=0, project_dir=str(tmp_path),
+        health=True, anomaly_action="warn",
+    )
+    data = [{"x": np.float32(i)} for i in range(64)]
+    with pytest.raises(RuntimeError, match="kaboom"):
+        rt.Launcher(
+            [rt.Looper([rt.Dataset(data, batch_size=8, fuse_gather=False),
+                        Boom()], tag="train", progress=False)],
+            num_epochs=1, runtime=runtime,
+        ).launch()
+    bundles = glob.glob(
+        str(tmp_path / "runs" / "telemetry" / "blackbox" / "*")
+    )
+    assert len(bundles) == 1
+    manifest = json.load(open(os.path.join(bundles[0], "blackbox.json")))
+    assert manifest["reason"] == "exception_RuntimeError"
+    assert "kaboom" in manifest["extra"]["exception"]
+    assert manifest["extra"]["tag"] == "train"
+
+
+def test_health_state_checkpoints_and_resumes(tmp_path):
+    """The sentinel state rides the model checkpoint; a pre-health
+    checkpoint (no health leaves) still restores with health enabled."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    clean = _poisoned_data(nan_from=0, nan_to=0)  # nothing poisoned
+
+    # Save WITHOUT health (the checkpoint carries no health/* leaves).
+    runtime = Runtime(mesh_shape={"data": 8}, seed=0,
+                      project_dir=str(tmp_path))
+    launcher, module, _ = _tree(
+        runtime, tmp_path, num_epochs=1, data=clean,
+        extra=(rt.Checkpointer(output_dir=ckpt_dir, save_every=4),),
+    )
+    launcher.launch()
+    assert os.path.isdir(os.path.join(ckpt_dir, "4"))
+
+    # Resume WITH health: the optional health leaves keep their fresh
+    # live values and the sentinels run from there.
+    runtime2 = Runtime(mesh_shape={"data": 8}, seed=0,
+                       project_dir=str(tmp_path), health=True)
+    launcher2, module2, _ = _tree(
+        runtime2, tmp_path, num_epochs=1, data=clean,
+        extra=(rt.Checkpointer(output_dir=ckpt_dir, save_every=1000,
+                               resume_from=os.path.join(ckpt_dir, "4"),
+                               resume_capsules=False),),
+    )
+    launcher2.launch()
+    summary = runtime2.health.summary()
+    assert summary["last_good_step"] is not None
+    assert summary["anomalies"] == 0
+
+
+def test_metric_publish_counts_nonfinite_host_scalars(tmp_path):
+    runtime = Runtime(seed=0, project_dir=str(tmp_path), health=True)
+    try:
+        metric = rt.Metric.__new__(rt.Metric)
+        rt.Capsule.__init__(metric)
+        metric.bind(runtime)
+        metric.publish(None, "val/acc", float("nan"))
+        metric.publish(None, "val/acc", 0.5)
+        counters = runtime.telemetry.registry.snapshot()["counters"]
+        assert counters["health/nonfinite_metrics"] == 1.0
+        assert runtime.health.summary()["nonfinite_metrics"] == 1
+    finally:
+        runtime.end_training()

@@ -5,7 +5,9 @@ global mesh), joined through a localhost coordinator via the same env vars
 ``Runtime._maybe_initialize_distributed`` reads in production. Exercises the
 branches that otherwise never run as true multihost: distributed init, the
 all-rank barrier, per-host striped loading, cross-process training
-collectives, and the sharded (gather-free) checkpoint save from BOTH hosts.
+collectives, the sharded (gather-free) checkpoint save from BOTH hosts, and
+the main-process-only gating of the obs outputs (telemetry.json, the span
+file and flight-recorder blackbox bundles are each written exactly once).
 """
 
 import pytest
@@ -28,7 +30,11 @@ from rocket_tpu import optim
 from rocket_tpu.models.mlp import MLP
 from rocket_tpu.runtime.context import Runtime
 
-runtime = Runtime(mesh_shape={"data": 4}, seed=0, project_dir=os.environ["OUT"])
+# Per-process project dir: file-once-only assertions below never race the
+# other rank — a file in proc1/ can only have been written by rank 1.
+_proc_dir = os.path.join(os.environ["OUT"], f"proc{os.environ['JAX_PROCESS_ID']}")
+runtime = Runtime(mesh_shape={"data": 4}, seed=0, project_dir=_proc_dir,
+                  telemetry=True, health=True, anomaly_action="skip_step")
 assert jax.process_count() == 2, jax.process_count()
 rank = runtime.process_index
 
@@ -71,6 +77,29 @@ tree = rt.Launcher(
     runtime=runtime,
 )
 tree.launch()
+
+# Obs outputs are written EXACTLY once, by the main process: telemetry.json
+# + spans land under rank 0's project dir only, and a forced flight-recorder
+# dump writes a bundle on rank 0 and returns None elsewhere. Health
+# sentinels ran multihost (replicated word, local-replica fetch).
+_bundle = runtime.flight.dump("mp_forced")
+_tel_dir = os.path.join(_proc_dir, "runs", "telemetry")
+if rank == 0:
+    assert os.path.exists(os.path.join(_tel_dir, "telemetry.json")), _tel_dir
+    assert os.path.exists(os.path.join(_tel_dir, "spans.trace.json"))
+    assert _bundle is not None and os.path.isdir(_bundle), _bundle
+    import glob as _glob
+    assert len(_glob.glob(os.path.join(_tel_dir, "blackbox", "*"))) == 1
+else:
+    assert not os.path.exists(os.path.join(_tel_dir, "telemetry.json")), (
+        "non-main process wrote telemetry.json")
+    assert not os.path.exists(os.path.join(_tel_dir, "spans.trace.json")), (
+        "non-main process wrote the span file")
+    assert _bundle is None
+    assert not os.path.isdir(os.path.join(_tel_dir, "blackbox")), (
+        "non-main process wrote a blackbox bundle")
+assert runtime.health.summary()["last_good_step"] is not None
+runtime.wait_for_everyone()
 
 # Both hosts contributed shard files; the index lists them.
 step_dir = os.path.join(ckpt_dir, "4", "model_0")

@@ -438,3 +438,71 @@ def test_report_cli_renders_telemetry_and_span_files(tmp_path):
         capture_output=True, text=True,
     )
     assert proc.returncode == 2
+
+
+def test_report_cli_zero_step_run_renders_no_steps_row(tmp_path):
+    """Satellite regression: a telemetry.json from a zero-step run (no
+    fractions block, zero wall-clock) must render an explicit "no steps
+    recorded" row — never crash on the degenerate goodput record."""
+    zero = {
+        "version": 1,
+        "goodput": {
+            "total_wall_s": 0.0,
+            "categories": {cat: 0.0 for cat in
+                           ("compile", "data_wait", "step", "checkpoint",
+                            "flush", "other")},
+            # No "fractions" key: the CLI must derive them with a guarded
+            # division (total == 0 was the ZeroDivision hazard).
+        },
+        "metrics": {"counters": {}, "gauges": {}, "histograms": {}},
+        "spans": {"file": "spans.trace.json", "events": 0, "dropped": 0},
+        "watchdog": {"enabled": False, "deadline_s": None, "stalls": 0},
+    }
+    path = tmp_path / "telemetry.json"
+    path.write_text(json.dumps(zero))
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "report", str(path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "no steps recorded" in proc.stdout
+    assert "ZeroDivisionError" not in proc.stderr
+
+    # A freshly constructed (zero-step) Telemetry's own flush renders too.
+    tel = Telemetry(enabled=True)
+    out = tel.flush(str(tmp_path / "fresh"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "report",
+         os.path.join(out, "telemetry.json")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "no steps recorded" in proc.stdout
+
+
+def test_span_drops_surface_as_metric_and_teardown_warning(tmp_path, caplog):
+    """Satellite: SpanRecorder drops become an obs/spans_dropped registry
+    metric and a one-line teardown warning, so a truncated trace is never
+    mistaken for a complete one."""
+    import logging
+
+    logger = logging.getLogger("rocket_tpu.test_obs_drops")
+    tel = Telemetry(enabled=True, max_span_events=2, logger=logger)
+    for i in range(5):
+        with tel.span(f"s{i}", cat="step"):
+            pass
+    assert tel.spans.dropped == 3
+    assert tel.scalars_snapshot()["obs/spans_dropped"] == 3.0
+    assert tel.summary()["metrics"]["gauges"]["obs/spans_dropped"] == 3.0
+    with caplog.at_level("WARNING", logger=logger.name):
+        tel.close(str(tmp_path), write=False)
+    assert any("span(s) dropped" in rec.message for rec in caplog.records)
+
+    # A clean run stays quiet.
+    tel2 = Telemetry(enabled=True, logger=logger)
+    with tel2.span("ok", cat="step"):
+        pass
+    caplog.clear()
+    with caplog.at_level("WARNING", logger=logger.name):
+        tel2.close(str(tmp_path), write=False)
+    assert not any("dropped" in rec.message for rec in caplog.records)
