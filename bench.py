@@ -255,7 +255,12 @@ def _bench_cnn(model, shape, batch, warmup, steps, metric, gmacs_fwd,
     return out
 
 
-def bench_resnet18(warmup=5, steps=30, batch=256):
+#: ResNet-18 bench batch — shared with the sched-audit calibration leg
+#: so the predicted and the measured step stay the same program.
+RESNET18_BATCH = 256
+
+
+def bench_resnet18(warmup=5, steps=30, batch=RESNET18_BATCH):
     # CIFAR-stem ResNet-18 @32x32: ~0.557 G-MACs forward per sample.
     return _bench_cnn(
         resnet18(num_classes=10, stem="cifar"), (32, 32, 3), batch,
@@ -350,11 +355,24 @@ def _bench_lm(config, batch, warmup, steps, name, lr=3e-4):
     return out
 
 
-def bench_charlm(warmup=5, steps=40):
+#: charlm bench batch — shared with the sched-audit calibration leg.
+CHARLM_BATCH = 128
+
+
+def charlm_config():
+    """The charlm bench model config, built ONCE — the sched-audit
+    calibration leg predicts exactly the config this bench measures."""
     tok = CharTokenizer(synthetic_corpus(10_000))
-    config = TransformerConfig.char_lm(vocab_size=tok.vocab_size, max_seq_len=256)
+    config = TransformerConfig.char_lm(
+        vocab_size=tok.vocab_size, max_seq_len=256
+    )
     config.dropout = 0.0
-    return _bench_lm(config, batch=128, warmup=warmup, steps=steps, name="charlm")
+    return config
+
+
+def bench_charlm(warmup=5, steps=40):
+    return _bench_lm(charlm_config(), batch=CHARLM_BATCH, warmup=warmup,
+                     steps=steps, name="charlm")
 
 
 def bench_gpt2(warmup=5, steps=30):
@@ -660,6 +678,152 @@ def prec_audit_summary(budgets_dir=PREC_BUDGETS_DIR):
     )
 
 
+#: Schedule-budget directory the roofline auditor maintains
+#: (``python -m rocket_tpu.analysis sched --update-budgets``).
+SCHED_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "sched",
+)
+
+#: Configs the sched calibration leg re-predicts: name -> builder() ->
+#: (step_fn, variables, batch, donate, units_per_step). The builders
+#: derive the model config and batch from the SAME definitions the
+#: bench functions measure (charlm_config/CHARLM_BATCH,
+#: RESNET18_BATCH), so a bench-config edit cannot silently desync the
+#: calibration. Only configs whose measured record exists in this run's
+#: results are predicted (each costs one AOT compile).
+def _calib_charlm():
+    from rocket_tpu.analysis.shard_audit import _lm_parts
+
+    config = charlm_config()
+    step_fn, variables, batch, _rules, donate = _lm_parts(
+        None, config=config, batch_size=CHARLM_BATCH
+    )
+    return step_fn, variables, batch, donate, \
+        CHARLM_BATCH * config.max_seq_len  # tokens/step
+
+
+def _calib_resnet18():
+    from rocket_tpu.analysis.sched_audit import _resnet_parts
+
+    step_fn, variables, batch, _rules, donate = _resnet_parts(
+        batch_size=RESNET18_BATCH
+    )
+    return step_fn, variables, batch, donate, RESNET18_BATCH  # samples
+
+
+_SCHED_CALIBRATION = {
+    "charlm": _calib_charlm,
+    "resnet18": _calib_resnet18,
+}
+
+
+def sched_audit_summary(results=None, budgets_dir=SCHED_BUDGETS_DIR):
+    """Predicted step-time attribution + predicted-vs-measured
+    calibration for BENCH_DETAIL.json.
+
+    Two halves, both best-effort (None/partial on any failure — emission
+    must never die on the audits):
+
+    * the committed schedule-budget records (the numbers the sched
+      self-gate verifies every CI run): per-target predicted step time,
+      exposed-communication time, overlap fraction and the
+      compute/memory/comm attribution;
+    * a calibration leg re-predicting the step time of measured bench
+      configs (``_SCHED_CALIBRATION``) with the same roofline model, so
+      the model/reality drift is itself a tracked number.
+      ``calibration_error`` is (predicted - measured) / measured;
+      ``device_matched`` is False when the bench device's kind is not in
+      the peak table (the prediction then prices the reference kind and
+      the error mostly measures that mismatch — e.g. the CPU-only CI
+      container). Known structural drift: LM configs run the pallas
+      flash kernels on hardware while the fake-mesh compile takes the
+      XLA attention path, so conv configs calibrate much tighter.
+    """
+    out = {}
+    try:
+        from rocket_tpu.analysis import budgets as budgets_mod
+
+        names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
+            if f.endswith(".json")
+        )
+        targets = {}
+        worst_step = worst_exposed = 0.0
+        for name in names:
+            record = budgets_mod.load_budget(budgets_dir, name)
+            if record is None:
+                continue
+            targets[name] = {
+                key: record.get(key)
+                for key in ("predicted_step_time_us", "exposed_comm_us",
+                            "overlap_fraction", "predicted_mfu",
+                            "fractions", "bound")
+            }
+            worst_step = max(worst_step,
+                             record.get("predicted_step_time_us") or 0)
+            worst_exposed = max(worst_exposed,
+                                record.get("exposed_comm_us") or 0)
+        if targets:
+            out = {
+                "targets": targets,
+                "predicted_step_time_us": worst_step,
+                "exposed_comm_us": worst_exposed,
+                "source": "tests/fixtures/budgets/sched",
+            }
+    except Exception:  # noqa: BLE001 — emission must never die on this
+        pass
+    try:
+        calibration = _sched_calibration(results or {})
+        if calibration:
+            out["calibration"] = calibration
+    except Exception as exc:  # noqa: BLE001
+        log(f"bench: sched calibration failed: {exc!r}")
+    return out or None
+
+
+def _sched_calibration(results):
+    from rocket_tpu.analysis.sched_audit import (
+        DEFAULT_DEVICE_KIND,
+        audit_schedule,
+    )
+    from rocket_tpu.utils.perf import device_spec
+
+    kind = jax.devices()[0].device_kind
+    spec = device_spec(kind)
+    priced_kind = spec.kind if spec is not None else DEFAULT_DEVICE_KIND
+    entries = {}
+    for name, build in _SCHED_CALIBRATION.items():
+        record = results.get(name) or {}
+        value = record.get("value")
+        if not value or "error" in record:
+            continue
+        step_fn, variables, batch, donate, units_per_step = build()
+        report = audit_schedule(
+            step_fn, variables, batch, mesh_shape={"data": 1},
+            device_kind=priced_kind, donate_argnums=donate,
+            label=f"calib:{name}",
+        )
+        predicted_us = report.record.get("predicted_step_time_us")
+        if not predicted_us:
+            continue
+        # value is per-chip; bench configs above are single-chip runs,
+        # so units/step / value is the measured step time.
+        measured_us = units_per_step / value * 1e6
+        entries[name] = {
+            "predicted_step_time_us": predicted_us,
+            "measured_step_time_us": round(measured_us, 3),
+            "calibration_error": round(
+                (predicted_us - measured_us) / measured_us, 4
+            ),
+            "predicted_mfu": report.record.get("predicted_mfu"),
+            "overlap_fraction": report.record.get("overlap_fraction"),
+            "priced_for": priced_kind,
+            "device_matched": spec is not None,
+        }
+    return entries
+
+
 #: Where a telemetry-enabled bench run's record lands: bench trees carry
 #: no Tracker, so Runtime.end_training falls back to
 #: <project_dir>/runs/telemetry with project_dir "." — i.e. relative to
@@ -807,6 +971,12 @@ def write_detail(results, path=DETAIL_PATH, health=None):
         # Statically-audited numerics next to the measured throughput:
         # fp32-bytes fraction of the traced step + cast counts per target.
         detail["prec_audit"] = prec
+    sched = sched_audit_summary(results, SCHED_BUDGETS_DIR)
+    if sched is not None:
+        # Predicted step-time attribution (compute/memory/exposed-comm)
+        # per audited target + predicted-vs-measured calibration for the
+        # configs this run measured — model/reality drift is tracked.
+        detail["sched_audit"] = sched
     telemetry = telemetry_summary()
     if telemetry is not None:
         # Live-run goodput split (rocket_tpu.obs) from a telemetry-enabled

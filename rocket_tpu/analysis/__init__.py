@@ -24,6 +24,15 @@ rule catalog):
   softmax internals, state/collective narrowing, cast churn, uncast
   master params, and per-target numerics budgets (fp32-bytes fraction +
   cast counts). CLI: ``python -m rocket_tpu.analysis prec``.
+  Deliberate low-precision collectives (compressed gradients) are
+  certified per param-path glob with :func:`certify_collectives`.
+* :mod:`~rocket_tpu.analysis.sched_audit` — static roofline/schedule
+  audit: the same AOT-compiled step's HLO parsed into a dependency DAG,
+  each op priced against the device peak tables, and a two-stream
+  simulation attributing predicted step time to compute vs memory vs
+  exposed communication; exposed/convoyed collectives, memory-bound
+  critical paths, pallas block misfits, predicted-MFU floors and
+  schedule budgets. CLI: ``python -m rocket_tpu.analysis sched``.
 * strict mode — ``Runtime(strict=True)`` (``runtime/context.py``): a
   ``jax.transfer_guard`` plus a retrace counter enforcing the same
   contracts on a live run; the SPMD auditor's collective count is
@@ -42,6 +51,7 @@ from rocket_tpu.analysis.findings import (
 from rocket_tpu.analysis.prec_audit import (
     PrecAuditReport,
     audit_precision,
+    certify_collectives,
     collect_dtype_flow,
 )
 from rocket_tpu.analysis.rocketlint import lint_file, lint_paths, lint_source
@@ -49,8 +59,15 @@ from rocket_tpu.analysis.rules import (
     AST_RULES,
     AUDIT_RULES,
     PREC_RULES,
+    SCHED_RULES,
     SPMD_RULES,
     all_rules,
+)
+from rocket_tpu.analysis.sched_audit import (
+    SchedAuditReport,
+    audit_schedule,
+    collect_pallas_facts,
+    predict_compiled,
 )
 from rocket_tpu.analysis.shard_audit import (
     ShardAuditReport,
@@ -81,9 +98,15 @@ __all__ = [
     "audit_precision",
     "PrecAuditReport",
     "collect_dtype_flow",
+    "certify_collectives",
+    "audit_schedule",
+    "SchedAuditReport",
+    "collect_pallas_facts",
+    "predict_compiled",
     "AST_RULES",
     "AUDIT_RULES",
     "SPMD_RULES",
     "PREC_RULES",
+    "SCHED_RULES",
     "all_rules",
 ]

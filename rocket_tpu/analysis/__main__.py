@@ -1,6 +1,7 @@
-"""CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` | ``prec``.
+"""CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` |
+``prec`` | ``sched``.
 
-Three entry points, one process contract (exit 0 = clean, 1 = findings,
+Four entry forms, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
 (:func:`~rocket_tpu.analysis.findings.emit_findings`):
 
@@ -12,19 +13,27 @@ Three entry points, one process contract (exit 0 = clean, 1 = findings,
   (:mod:`rocket_tpu.analysis.shard_audit`): dead sharding rules,
   rank/divisibility mismatches, silently replicated params, excess
   collectives in the *compiled* module, and HBM/collective-bytes
-  budgets (``--budgets`` dir, ``--update-budgets`` to re-baseline);
-* ``prec`` audits the dtype flow of the repo's canonical train/eval
-  steps (:mod:`rocket_tpu.analysis.prec_audit`): low-precision
-  accumulation, sub-fp32 softmax internals, state narrowing, cast
-  churn, uncast master params, and the numerics budgets (fp32-bytes
-  fraction + cast counts; same ``--budgets``/``--update-budgets``
-  contract — the budget gate runs only when ``--budgets`` is given;
-  CI passes the canonical ``tests/fixtures/budgets/prec``).
+  budgets;
+* ``prec`` audits the dtype flow of the same canonical steps
+  (:mod:`rocket_tpu.analysis.prec_audit`): low-precision accumulation,
+  sub-fp32 softmax internals, state narrowing, cast churn, uncast
+  master params, and the numerics budgets;
+* ``sched`` audits the compiled *schedule* of the same steps
+  (:mod:`rocket_tpu.analysis.sched_audit`): a per-op roofline cost
+  model and a two-stream simulation attributing predicted step time to
+  compute vs memory vs exposed communication, plus pallas block/VMEM
+  checks and the schedule budgets.
+
+The audit subcommands are one registry (:data:`AUDIT_SUBCOMMANDS`)
+sharing a single flag set and budget write/diff loop, so ``--format``
+and the exit-code handling cannot drift apart per auditor. Every entry
+supports ``--budgets DIR`` (diff against the committed records, >10%
+growth fails) and ``--update-budgets`` (re-baseline).
 
 The jaxpr-audit rules (RKT2xx) need a concrete step function and
 example inputs, so they run from code/tests via
 :func:`rocket_tpu.analysis.audit_step`, not from this CLI;
-``--list-rules`` documents all four families.
+``--list-rules`` documents all five families.
 """
 
 from __future__ import annotations
@@ -32,6 +41,8 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from dataclasses import dataclass
+from typing import Callable
 
 from rocket_tpu.analysis.findings import emit_findings
 from rocket_tpu.analysis.rocketlint import lint_paths
@@ -57,15 +68,113 @@ def _provision_cpu_backend() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
-def _audit_main(argv, *, prog, description, targets, run_target,
-                budgets_help, list_line, budget_keys, budget_rule,
-                family) -> int:
-    """Shared scaffolding for the ``shard`` and ``prec`` subcommands:
-    one flag set, one demo-skip sweep, one budget write/diff loop — so
-    the two audit CLIs cannot drift apart."""
+@dataclass(frozen=True)
+class AuditCLI:
+    """One audit subcommand's registry entry — everything the shared
+    scaffolding needs: where the targets live, which budget keys gate,
+    and which rule id a regression reports as."""
+
+    name: str
+    description: str
+    #: () -> (targets dict, run_target fn) — imported lazily so `python
+    #: -m rocket_tpu.analysis --list-rules` stays cheap.
+    load: Callable[[], tuple]
+    #: attribute names on the budgets module (resolved lazily too)
+    budgets_dir_attr: str
+    gated_keys_attr: str
+    budget_rule: str
+    family: str
+    #: target -> one-line description for --list-targets
+    list_line: Callable[[object], str] = staticmethod(lambda t: "")
+
+
+def _load_shard():
+    from rocket_tpu.analysis.shard_audit import BUILTIN_TARGETS, run_target
+
+    return BUILTIN_TARGETS, run_target
+
+
+def _load_prec():
+    from rocket_tpu.analysis.prec_audit import PREC_TARGETS, run_prec_target
+
+    return PREC_TARGETS, run_prec_target
+
+
+def _load_sched():
+    from rocket_tpu.analysis.sched_audit import (
+        SCHED_TARGETS,
+        run_sched_target,
+    )
+
+    return SCHED_TARGETS, run_sched_target
+
+
+def _mesh_line(target) -> str:
+    return (
+        f"mesh={'x'.join(str(s) for s in target.mesh_shape.values())} "
+        f"({dict(target.mesh_shape)})"
+    )
+
+
+#: The one audit-subcommand registry `main` dispatches on.
+AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
+    cli.name: cli
+    for cli in (
+        AuditCLI(
+            name="shard",
+            description="static SPMD sharding / collective-traffic / "
+                        "HBM-budget audit on fake CPU meshes",
+            load=_load_shard,
+            budgets_dir_attr="DEFAULT_DIR",
+            gated_keys_attr="GATED_KEYS",
+            budget_rule="RKT306",
+            family="spmd",
+            list_line=_mesh_line,
+        ),
+        AuditCLI(
+            name="prec",
+            description="static dtype-flow / mixed-precision audit of "
+                        "the repo's canonical train/eval steps",
+            load=_load_prec,
+            budgets_dir_attr="PREC_DIR",
+            gated_keys_attr="PREC_GATED_KEYS",
+            budget_rule="RKT406",
+            family="prec",
+            list_line=lambda t: f"compute={t.compute_dtype.__name__}",
+        ),
+        AuditCLI(
+            name="sched",
+            description="static roofline / HLO-schedule / comm-overlap "
+                        "audit with predicted step-time attribution",
+            load=_load_sched,
+            budgets_dir_attr="SCHED_DIR",
+            gated_keys_attr="SCHED_GATED_KEYS",
+            budget_rule="RKT506",
+            family="sched",
+            list_line=lambda t: (
+                f"{_mesh_line(t)} device={t.device_kind}"
+                + ("" if t.compile_hlo else "  [jaxpr-only]")
+            ),
+        ),
+    )
+}
+
+
+def _audit_main(cli: AuditCLI, argv) -> int:
+    """Shared scaffolding for every audit subcommand: one flag set, one
+    demo-skip sweep, one budget write/diff loop — so the audit CLIs
+    cannot drift apart."""
+    _provision_cpu_backend()
     from rocket_tpu.analysis import budgets as budgets_mod
 
-    parser = argparse.ArgumentParser(prog=prog, description=description)
+    targets, run_target = cli.load()
+    default_dir = getattr(budgets_mod, cli.budgets_dir_attr)
+    budget_keys = getattr(budgets_mod, cli.gated_keys_attr)
+
+    parser = argparse.ArgumentParser(
+        prog=f"python -m rocket_tpu.analysis {cli.name}",
+        description=cli.description,
+    )
     parser.add_argument(
         "--target", action="append", choices=sorted(targets),
         help="audit only these targets (default: every non-demo target)",
@@ -74,8 +183,9 @@ def _audit_main(argv, *, prog, description, targets, run_target,
                         help="print the target catalog and exit")
     parser.add_argument(
         "--budgets", default=None, metavar="DIR",
-        help=f"{budgets_help}: diff each target against its committed "
-        f"record and fail on >{budgets_mod.TOLERANCE * 100:.0f}%% growth "
+        help=f"budget-file directory (canonical: {default_dir}): diff "
+        "each target against its committed record and fail on "
+        f">{budgets_mod.TOLERANCE * 100:.0f}%% growth "
         "(no DIR = findings only, no budget gate)",
     )
     parser.add_argument(
@@ -93,7 +203,7 @@ def _audit_main(argv, *, prog, description, targets, run_target,
     if args.list_targets:
         for name, target in sorted(targets.items()):
             tag = "  [demo]" if target.demo else ""
-            print(f"{name:14s} {list_line(target)}{tag}")
+            print(f"{name:14s} {cli.list_line(target)}{tag}")
         return 0
     if args.update_budgets and not args.budgets:
         parser.error("--update-budgets requires --budgets DIR")
@@ -106,7 +216,7 @@ def _audit_main(argv, *, prog, description, targets, run_target,
         target = targets[name]
         report = run_target(target)
         findings.extend(report.findings)
-        if target.demo or not args.budgets:
+        if target.demo or not args.budgets or not report.record:
             continue
         if args.update_budgets:
             budgets_mod.write_budget(args.budgets, name, report.record)
@@ -114,74 +224,23 @@ def _audit_main(argv, *, prog, description, targets, run_target,
             findings.extend(budgets_mod.diff_budget(
                 name, budgets_mod.load_budget(args.budgets, name),
                 report.record, tolerance=args.tolerance,
-                keys=budget_keys, rule=budget_rule, family=family,
+                keys=budget_keys, rule=cli.budget_rule, family=cli.family,
             ))
 
     emit_findings(findings, fmt=args.format)
     return 1 if findings else 0
 
 
-def _shard_main(argv) -> int:
-    _provision_cpu_backend()
-
-    from rocket_tpu.analysis import budgets as budgets_mod
-    from rocket_tpu.analysis.shard_audit import BUILTIN_TARGETS, run_target
-
-    return _audit_main(
-        argv,
-        prog="python -m rocket_tpu.analysis shard",
-        description="static SPMD sharding / collective-traffic / "
-                    "HBM-budget audit on fake CPU meshes",
-        targets=BUILTIN_TARGETS,
-        run_target=run_target,
-        budgets_help=f"budget-file directory "
-                     f"(canonical: {budgets_mod.DEFAULT_DIR})",
-        list_line=lambda t: (
-            f"mesh={'x'.join(str(s) for s in t.mesh_shape.values())} "
-            f"({dict(t.mesh_shape)})"
-        ),
-        budget_keys=budgets_mod.GATED_KEYS,
-        budget_rule="RKT306",
-        family="spmd",
-    )
-
-
-def _prec_main(argv) -> int:
-    # The dtype-flow walk is pure abstract evaluation, but sharing the
-    # backend bootstrap keeps the subcommands interchangeable in CI and
-    # lets user steps traced here contain shard_map collectives.
-    _provision_cpu_backend()
-
-    from rocket_tpu.analysis import budgets as budgets_mod
-    from rocket_tpu.analysis.prec_audit import PREC_TARGETS, run_prec_target
-
-    return _audit_main(
-        argv,
-        prog="python -m rocket_tpu.analysis prec",
-        description="static dtype-flow / mixed-precision audit of the "
-                    "repo's canonical train/eval steps",
-        targets=PREC_TARGETS,
-        run_target=run_prec_target,
-        budgets_help=f"numerics-budget directory "
-                     f"(canonical: {budgets_mod.PREC_DIR})",
-        list_line=lambda t: f"compute={t.compute_dtype.__name__}",
-        budget_keys=budgets_mod.PREC_GATED_KEYS,
-        budget_rule="RKT406",
-        family="prec",
-    )
-
-
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] == "shard":
-        return _shard_main(argv[1:])
-    if argv and argv[0] == "prec":
-        return _prec_main(argv[1:])
+    if argv and argv[0] in AUDIT_SUBCOMMANDS:
+        return _audit_main(AUDIT_SUBCOMMANDS[argv[0]], argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.analysis",
         description="rocketlint: static analysis for rocket_tpu fast "
-                    "paths (see also the `shard` and `prec` subcommands)",
+                    "paths (see also the `shard`, `prec` and `sched` "
+                    "subcommands)",
     )
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")
@@ -199,7 +258,8 @@ def main(argv=None) -> int:
             print(f"{rule_id}  {slug:22s} {contract}")
         return 0
     if not args.paths:
-        parser.error("no paths given (or use --list-rules / shard)")
+        parser.error("no paths given (or use --list-rules, or a "
+                     "subcommand: " + ", ".join(AUDIT_SUBCOMMANDS) + ")")
 
     select = (
         [r.strip() for r in args.select.split(",") if r.strip()]

@@ -42,6 +42,7 @@ __all__ = [
     "TOLERANCE",
     "GATED_KEYS",
     "PREC_GATED_KEYS",
+    "SCHED_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -59,11 +60,18 @@ GATED_KEYS = ("collective_bytes_per_step", "hbm_per_device_bytes")
 #: fraction gates fp32 memory creep; the cast counts gate HLO churn.
 PREC_GATED_KEYS = ("fp32_bytes_fraction", "widen_casts", "narrow_casts")
 
+#: Record keys the schedule (roofline) gate compares — RKT506. Both are
+#: monotone cost metrics from the static schedule simulation: total
+#: predicted step time and the exposed (non-overlapped) collective time.
+SCHED_GATED_KEYS = ("predicted_step_time_us", "exposed_comm_us")
+
 #: Default budgets directory, resolved relative to the repo checkout.
-#: The precision budgets live in a ``prec/`` subdirectory so BENCH's
-#: per-target sweep over ``*.json`` never mixes the two record shapes.
+#: The precision/schedule budgets live in ``prec/`` / ``sched/``
+#: subdirectories so BENCH's per-target sweep over ``*.json`` never
+#: mixes the record shapes.
 DEFAULT_DIR = os.path.join("tests", "fixtures", "budgets")
 PREC_DIR = os.path.join(DEFAULT_DIR, "prec")
+SCHED_DIR = os.path.join(DEFAULT_DIR, "sched")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -110,7 +118,7 @@ def diff_budget(
     silently gate nothing.
     """
     path = f"<{family}:{target}>"
-    subcommand = "shard" if family == "spmd" else "prec"
+    subcommand = {"spmd": "shard", "sched": "sched"}.get(family, "prec")
     if committed is None:
         return [Finding(
             rule, path, 0,

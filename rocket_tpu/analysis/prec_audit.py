@@ -66,10 +66,44 @@ __all__ = [
     "DtypeFlow",
     "PrecAuditReport",
     "audit_precision",
+    "certify_collectives",
     "collect_dtype_flow",
     "PREC_TARGETS",
     "run_prec_target",
 ]
+
+
+#: Attribute the certification decorator stores its globs on.
+_CERTIFIED_ATTR = "_rocket_certified_collectives"
+
+
+def certify_collectives(*path_globs: str):
+    """Certify a step function's DELIBERATE low-precision collectives.
+
+    ROADMAP item 3's compressed-gradient collectives are exactly what
+    RKT403 exists to catch — a param narrowed below its master dtype
+    crossing a device boundary. A scheme that compresses **on purpose**
+    (bf16/fp8 gradient all-reduce with an fp32 master-param guarantee
+    elsewhere) declares it explicitly, per param-path glob, on the step
+    function::
+
+        @certify_collectives("params/blocks/*/mlp/*/w")
+        def train_step(variables, batch): ...
+
+    The audit then skips RKT403 for collectives whose param path matches
+    a glob — and flags any glob that matched *nothing*, so the
+    certification list stays an exact, reviewable audit trail instead of
+    a blanket suppression (``# rocketlint: disable=RKT403`` would
+    silence the whole family). Stacks with other decorators as long as
+    they preserve attributes (functools.wraps does).
+    """
+
+    def deco(fn):
+        existing = tuple(getattr(fn, _CERTIFIED_ATTR, ()))
+        setattr(fn, _CERTIFIED_ATTR, existing + tuple(path_globs))
+        return fn
+
+    return deco
 
 
 # -- facts the walk collects -------------------------------------------------
@@ -591,6 +625,7 @@ def audit_precision(
     fp32_compute_bytes_min: int = 1 << 16,
     max_cast_churn: int = 0,
     check_state: bool = True,
+    certified_collectives: Tuple[str, ...] = (),
     label: str = "step",
 ) -> PrecAuditReport:
     """Audit the dtype flow of ``step_fn(variables, batch)``.
@@ -604,9 +639,15 @@ def audit_precision(
     A ``# rocketlint: disable=RKT4xx`` directive anywhere in ``fn``'s own
     source suppresses that rule for this audit (trace_audit parity —
     dtype findings carry no source line, so the directive scopes to the
-    audited function).
+    audited function). Deliberate low-precision collectives are
+    certified per param-path glob instead — via the
+    :func:`certify_collectives` decorator on ``step_fn`` or the
+    ``certified_collectives`` argument (both merge).
     """
     suppressed = _fn_suppressed_rules(step_fn, prefix="RKT4")
+    certified = tuple(certified_collectives) + tuple(
+        getattr(step_fn, _CERTIFIED_ATTR, ())
+    )
     flow, in_dtypes, out_dtypes = collect_dtype_flow(
         step_fn, variables, batch, compute_dtype=compute_dtype
     )
@@ -621,7 +662,9 @@ def audit_precision(
         findings.extend(check_state_dtypes(
             in_dtypes, out_dtypes, label=label
         ))
-    findings.extend(check_collective_operands(flow.collectives, label=label))
+    findings.extend(check_collective_operands(
+        flow.collectives, certified=certified, label=label
+    ))
     findings.extend(check_cast_churn(
         flow.churn_count, flow.churn_elems, max_churn=max_cast_churn,
         label=label,
@@ -643,6 +686,9 @@ def audit_precision(
         "cast_churn": int(flow.churn_count),
         "compute_dtype": str(np.dtype(compute_dtype))
         if compute_dtype is not None else None,
+        # Context, not a gate: how many low-precision collectives this
+        # step explicitly certified (compressed-gradient schemes).
+        "certified_collectives": len(certified),
     }
     return PrecAuditReport(
         label=label, findings=findings, flow=flow, record=record

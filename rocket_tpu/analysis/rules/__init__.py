@@ -29,10 +29,11 @@ from rocket_tpu.analysis.rules.jit_rules import (
     TracerLeakRule,
 )
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
+from rocket_tpu.analysis.rules.sched_rules import SCHED_RULES
 from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
 __all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
-           "all_rules"]
+           "SCHED_RULES", "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -71,9 +72,10 @@ AUDIT_RULES = (
 
 def all_rules():
     """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
-    (RKT2xx), SPMD audit (RKT3xx) and precision audit (RKT4xx) — in id
-    order."""
+    (RKT2xx), SPMD audit (RKT3xx), precision audit (RKT4xx) and schedule
+    audit (RKT5xx) — in id order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
     return tuple(sorted(
         ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
+        + list(SCHED_RULES)
     ))

@@ -60,7 +60,9 @@ PREC_RULES = (
      "optimizer/EMA/model state leaves the step narrower than it "
      "entered, or a cross-device collective moves a param narrowed from "
      "its master dtype: master-weight precision erodes a little every "
-     "step"),
+     "step; deliberate compressed-gradient collectives are certified "
+     "per param-path glob with @certify_collectives (a stale "
+     "certification is itself a finding)"),
     ("RKT404", "cast-churn",
      "a value is widened and immediately narrowed back (bf16->f32->bf16) "
      "with nothing in between: dead converts that inflate the HLO and "
@@ -201,19 +203,50 @@ def check_state_dtypes(
 
 def check_collective_operands(
     collectives: Sequence,  # prec_audit.CollectiveFact
+    certified: Sequence[str] = (),
     label: str = "step",
 ) -> list[Finding]:
     """RKT403 (collective half): a cross-device collective whose operand
     was narrowed from a param's master dtype — the reduction/gather then
-    happens at compute precision and every device keeps the eroded copy."""
+    happens at compute precision and every device keeps the eroded copy.
+
+    ``certified`` holds param-path globs the step EXPLICITLY certifies
+    for low-precision collectives (compressed-gradient schemes — see
+    :func:`rocket_tpu.analysis.prec_audit.certify_collectives`): a
+    matching fact is deliberate and not flagged. Certification is
+    per-path, never blanket — a glob that certifies *nothing the audit
+    saw* is itself a finding, so stale allowlists cannot rot silently.
+    """
+    from fnmatch import fnmatchcase
+
     findings = []
+    used: set = set()
     for fact in collectives:
+        path = "/".join(fact.param_path)
+        # Credit EVERY matching glob: a specific certification listed
+        # alongside a broader overlapping one must not read as stale.
+        matched = [glob for glob in certified if fnmatchcase(path, glob)]
+        if matched:
+            used.update(matched)
+            continue
         findings.append(Finding(
             "RKT403", _prec_path(label), 0,
             f"state-narrowed: collective {fact.prim} moves "
-            f"{'/'.join(fact.param_path) or 'a param'} narrowed "
+            f"{path or 'a param'} narrowed "
             f"{fact.master_dtype}->{fact.dtype} at {fact.narrowed_at} — "
-            "collectives over master state run at the master dtype",
+            "collectives over master state run at the master dtype "
+            "(or certify the compression: "
+            "@certify_collectives('<param glob>'))",
+        ))
+    for glob in certified:
+        if glob in used:
+            continue
+        findings.append(Finding(
+            "RKT403", _prec_path(label), 0,
+            f"state-narrowed: certification {glob!r} matched no "
+            "low-precision collective in this step — remove the stale "
+            "certification (certified paths must stay an exact audit "
+            "trail, not a blanket suppression)",
         ))
     return findings
 

@@ -1,0 +1,278 @@
+"""Schedule/roofline audit rules (``RKT5xx``) — checks over the simulated
+schedule of a compiled step.
+
+The SPMD auditor (RKT3xx) prices collective *bytes*; this family prices
+*time*: every instruction in the optimized HLO gets a roofline cost
+(FLOPs against the MXU peak, bytes against HBM bandwidth, collective
+bytes against ICI bandwidth — :func:`rocket_tpu.utils.perf.device_spec`)
+and a two-stream schedule simulation attributes the predicted step time
+to compute vs memory vs exposed (non-overlapped) communication. The
+checks then ask the questions a profiler answers after burning hardware
+hours — is communication hiding behind independent compute, are small
+collectives convoying, is the critical path memory-bound — before any
+run, on the same fake-mesh AOT compile the SPMD audit uses.
+
+The HLO/DAG parsing, cost model, simulation and builtin targets live in
+:mod:`rocket_tpu.analysis.sched_audit`; this module holds the catalog
+plus the fact->Finding checks, so the rule logic is testable without
+compiling anything.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "SCHED_RULES",
+    "check_exposed_comm",
+    "check_convoys",
+    "check_memory_bound",
+    "check_pallas",
+    "check_mfu_floor",
+]
+
+#: (id, slug, contract) — the catalog, same shape as SPMD_RULES.
+SCHED_RULES = (
+    ("RKT501", "exposed-collective",
+     "collective time sits exposed on the critical path while independent "
+     "compute exists to hide it (sync schedule vs ideal-overlap simulation "
+     "diverge): async/overlapped collectives or resharding would shorten "
+     "the step"),
+    ("RKT502", "collective-convoy",
+     "a run of small back-to-back collectives with no real compute between "
+     "them: per-op latency dominates bytes — bucket or fuse them into fewer "
+     "larger collectives"),
+    ("RKT503", "memory-bound-critical-path",
+     "large memory-bound fusions (arithmetic intensity below the device "
+     "ridge point) dominate the predicted step time: the step is paying "
+     "HBM bandwidth, not MXU — fuse, cast down, or restructure the chain"),
+    ("RKT504", "pallas-block-misfit",
+     "a pallas_call's blocks overflow the device VMEM budget (double-"
+     "buffered estimate) or a block shape misaligns with the device tile "
+     "(last dim % 128, sublane % 8/16/32 by dtype): the kernel spills or "
+     "pads every grid step"),
+    ("RKT505", "predicted-mfu-floor",
+     "the roofline-predicted MFU of the compiled step fell below the "
+     "target's declared floor: the schedule regressed structurally (new "
+     "reshards, lost fusion, serialized collectives) even if no budget "
+     "metric moved"),
+    ("RKT506", "schedule-budget-regression",
+     "the predicted step time or exposed-communication time grew more than "
+     "the tolerance over the checked-in schedule budget file"),
+)
+
+#: Minimum sublane multiple by dtype itemsize (second-to-last block dim);
+#: the lane (last) dim is always 128. See the pallas guide's tile table.
+_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+
+def _sched_path(label: str) -> str:
+    return f"<sched:{label}>"
+
+
+def _us(seconds: float) -> str:
+    return f"{seconds * 1e6:.1f}us"
+
+
+def check_exposed_comm(
+    sim,          # sched_audit.SimResult (scheduled/sync semantics)
+    ideal,        # sched_audit.SimResult (ideal-overlap semantics)
+    *,
+    exposed_frac_min: float = 0.15,
+    exposed_min_s: float = 20e-6,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT501: exposed collective time the DAG itself could hide.
+
+    ``sim`` prices the schedule as compiled (sync collectives block);
+    ``ideal`` re-runs the same DAG with every collective on its own
+    stream. The difference is communication that independent compute
+    COULD hide — exposure that is structural (a collective feeding the
+    very next op) appears in both simulations and is not flagged.
+    """
+    headroom = max(0.0, sim.exposed_comm_s - ideal.exposed_comm_s)
+    step = max(sim.makespan_s, 1e-12)
+    if headroom < exposed_min_s or headroom / step < exposed_frac_min:
+        return []
+    worst = sorted(
+        (op for op in sim.ops if op.is_comm and op.time_s > 0),
+        key=lambda op: op.time_s, reverse=True,
+    )[:3]
+    tops = "; ".join(
+        f"{op.opcode} {_us(op.time_s)} ({op.where or op.name})" for op in worst
+    )
+    return [Finding(
+        "RKT501", _sched_path(label), 0,
+        f"exposed-collective: {_us(headroom)} of {_us(sim.exposed_comm_s)} "
+        f"exposed collective time ({headroom / step * 100:.0f}% of the "
+        f"{_us(step)} step) could hide behind independent compute — "
+        f"overlap/async the collectives or reshard to remove them; "
+        f"largest: {tops}",
+    )]
+
+
+def check_convoys(
+    ops: Sequence,   # sched_audit.OpCost, schedule order
+    *,
+    convoy_min: int = 6,
+    bucket_bytes: int = 4 << 20,
+    gap_bytes: int = 1 << 16,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT502: runs of small collectives back-to-back in the schedule.
+
+    A run is broken only by an op that moves more than ``gap_bytes`` of
+    HBM traffic (tiny interleaved fusions — a scalar scale, a bias add —
+    do not hide latency). Runs of ``convoy_min``+ collectives whose MEAN
+    payload is under ``bucket_bytes`` are latency-dominated: one bucketed
+    collective would move the same bytes at a fraction of the latency.
+    """
+    findings = []
+    run: list = []
+    def flush():
+        if len(run) < convoy_min:
+            return
+        total = sum(op.comm_bytes for op in run)
+        mean = total / len(run)
+        if mean >= bucket_bytes:
+            return
+        kinds = {}
+        for op in run:
+            kinds[op.opcode] = kinds.get(op.opcode, 0) + 1
+        kind_s = ", ".join(f"{n}x {k}" for k, n in sorted(kinds.items()))
+        findings.append(Finding(
+            "RKT502", _sched_path(label), 0,
+            f"collective-convoy: {len(run)} back-to-back collectives "
+            f"({kind_s}) moving {total / 2**20:.2f} MiB total "
+            f"(mean {mean / 2**10:.0f} KiB/op, "
+            f"{_us(sum(op.time_s for op in run))}) — bucket/fuse them "
+            f"into fewer larger collectives; first at "
+            f"{run[0].where or run[0].name}",
+        ))
+    for op in ops:
+        if op.is_comm:
+            if op.comm_bytes > 0 or op.time_s > 0:
+                run.append(op)
+            continue
+        if op.hbm_bytes > gap_bytes:
+            flush()
+            run = []
+    flush()
+    return findings
+
+
+def check_memory_bound(
+    ops: Sequence,   # sched_audit.OpCost
+    makespan_s: float,
+    ridge: float,
+    *,
+    memory_frac_max: float = 0.6,
+    min_bytes: int = 1 << 20,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT503: large memory-bound ops dominating the predicted step.
+
+    Only ops moving ``min_bytes``+ count — a tiny model is legitimately
+    all memory-bound and a norm-scale fusion is policy, not a hazard.
+    The finding names the top offenders with their source locations so
+    the fix (fuse, narrow the dtype, restructure) has an address.
+    """
+    heavy = [
+        op for op in ops
+        if op.kind == "memory" and not op.is_comm
+        and op.hbm_bytes >= min_bytes
+    ]
+    total = sum(op.time_s for op in heavy)
+    step = max(makespan_s, 1e-12)
+    if not heavy or total / step <= memory_frac_max:
+        return []
+    worst = sorted(heavy, key=lambda op: op.time_s, reverse=True)[:3]
+    tops = "; ".join(
+        f"{op.opcode} {op.hbm_bytes / 2**20:.1f} MiB "
+        f"AI={op.intensity:.1f} {_us(op.time_s)} ({op.where or op.name})"
+        for op in worst
+    )
+    return [Finding(
+        "RKT503", _sched_path(label), 0,
+        f"memory-bound-critical-path: {len(heavy)} fusions moving >= "
+        f"{min_bytes >> 20} MiB each at arithmetic intensity below the "
+        f"ridge ({ridge:.0f} FLOP/B) take {_us(total)} of the {_us(step)} "
+        f"step ({total / step * 100:.0f}%) — the step pays HBM bandwidth, "
+        f"not MXU; worst: {tops}",
+    )]
+
+
+def check_pallas(
+    facts: Sequence,  # sched_audit.PallasFact
+    vmem_bytes: Optional[int],
+    *,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT504: pallas_call VMEM over-budget / misaligned block shapes."""
+    findings = []
+    seen: set = set()
+    for fact in facts:
+        if vmem_bytes and fact.vmem_bytes_est > vmem_bytes:
+            key = (fact.name, "vmem")
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    "RKT504", _sched_path(label), 0,
+                    f"pallas-block-misfit: {fact.name} needs "
+                    f"~{fact.vmem_bytes_est / 2**20:.1f} MiB VMEM "
+                    f"(double-buffered blocks) over the "
+                    f"{vmem_bytes >> 20} MiB budget — shrink block shapes "
+                    "or split the grid",
+                ))
+        for shape, dtype in fact.blocks:
+            dims = tuple(1 if d is None else int(d) for d in shape)
+            if not dims:
+                continue
+            full = fact.full_shapes.get((shape, dtype))
+            itemsize = np.dtype(dtype).itemsize
+            sub = _SUBLANE.get(itemsize, 8)
+            bad = []
+            if dims[-1] % 128 and not (full and dims[-1] == full[-1]):
+                bad.append(f"last dim {dims[-1]} % 128")
+            if (len(dims) >= 2 and dims[-2] % sub
+                    and not (full and len(full) >= 2
+                             and dims[-2] == full[-2])):
+                bad.append(f"sublane dim {dims[-2]} % {sub} ({dtype})")
+            if not bad:
+                continue
+            key = (fact.name, shape, dtype)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "RKT504", _sched_path(label), 0,
+                f"pallas-block-misfit: {fact.name} block {list(dims)} "
+                f"{dtype} misaligns with the device tile "
+                f"({'; '.join(bad)}) — the compiler pads every grid step; "
+                "align the block to the (sublane, 128) tile or use the "
+                "full array dim",
+            ))
+    return findings
+
+
+def check_mfu_floor(
+    predicted_mfu: Optional[float],
+    floor: float,
+    *,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT505: roofline-predicted MFU below the target's declared floor."""
+    if predicted_mfu is None or floor <= 0 or predicted_mfu >= floor:
+        return []
+    return [Finding(
+        "RKT505", _sched_path(label), 0,
+        f"predicted-mfu-floor: roofline-predicted MFU "
+        f"{predicted_mfu:.3f} fell below this target's floor {floor:.3f} "
+        "— the compiled schedule regressed (new reshards, lost fusion, "
+        "serialized collectives); inspect the step-time attribution and "
+        "re-baseline the floor only if the regression is intended",
+    )]

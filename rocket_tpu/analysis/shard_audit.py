@@ -54,6 +54,8 @@ __all__ = [
     "ShardAuditReport",
     "parse_collectives",
     "resolve_specs",
+    "resolve_placement",
+    "aot_compile_step",
     "estimate_hbm",
     "audit_sharding",
     "BUILTIN_TARGETS",
@@ -302,41 +304,28 @@ def _abstract(leaf, sharding) -> jax.ShapeDtypeStruct:
     )
 
 
-def audit_sharding(
-    step_fn: Callable,
+def resolve_placement(
     variables,
     batch,
     *,
     rules: Callable[[Tuple[str, ...], Any], Spec],
-    mesh_shape: Mapping[str, int],
-    mesh: Optional[jax.sharding.Mesh] = None,
+    mesh: jax.sharding.Mesh,
     data_axes: Tuple[str, ...] = ("data",),
-    allow: Optional[Mapping[str, int]] = None,
     replicated_bytes_limit: int = 1 << 20,
-    optimizer_slots: int = 2,
-    donate_argnums: Sequence[int] = (),
     label: str = "step",
-) -> ShardAuditReport:
-    """Audit ``step_fn(variables, batch)`` under ``rules`` on a fake mesh.
+) -> tuple:
+    """Resolve ``rules`` over ``variables`` and build the abstract,
+    ``NamedSharding``-annotated inputs the AOT compile consumes.
 
-    ``variables`` / ``batch`` may be concrete arrays or
-    ``ShapeDtypeStruct``s (``jax.eval_shape(model.init, key)`` output is
-    the intended zero-FLOP path). The rules address the ``"params"``
-    subtree of ``variables`` when present (the ``Module`` convention),
-    the whole tree otherwise; batch leaves are sharded over ``data_axes``
-    on their leading dim when divisible, replicated otherwise.
-
-    Returns a :class:`ShardAuditReport`; ``report.record`` is the budget
-    record (:mod:`rocket_tpu.analysis.budgets`) and ``report.findings``
-    the RKT30x hits. Pure abstract evaluation + XLA compilation — no
-    FLOPs run, no params materialize, no TPU required.
+    Returns ``(abs_variables, abs_batch, specs, findings)`` — the static
+    rule findings (RKT301-304) come out here so both the SPMD auditor
+    and the schedule auditor report them from one resolution. When a
+    spec is structurally unplaceable (rank mismatch / indivisible) every
+    param falls back to replicated so the compile can still proceed.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if mesh is None:
-        mesh = _mesh_from_shape(mesh_shape)
     mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-
     params = (
         variables["params"]
         if isinstance(variables, dict) and "params" in variables
@@ -393,9 +382,26 @@ def audit_sharding(
     abs_batch = jax.tree.map(
         lambda l: _abstract(l, batch_sharding(l)), batch
     )
+    return abs_variables, abs_batch, specs, findings
 
-    collectives: list[CollectiveOp] = []
-    compiled = None
+
+def aot_compile_step(
+    step_fn: Callable,
+    abs_variables,
+    abs_batch,
+    *,
+    mesh: jax.sharding.Mesh,
+    donate_argnums: Sequence[int] = (),
+    label: str = "step",
+) -> tuple:
+    """AOT-compile ``step_fn`` on the fake mesh; ``(compiled, findings)``.
+
+    A placement XLA itself rejects (XlaRuntimeError is a RuntimeError;
+    sharding/mesh complaints are ValueErrors) becomes an RKT303 finding
+    with ``compiled=None``, so one audit reports every bad rule instead
+    of dying on the first. Anything else (TypeError from a mismatched
+    step/batch pairing, etc.) is a caller bug and propagates as-is.
+    """
     try:
         with mesh:
             compiled = (
@@ -403,19 +409,62 @@ def audit_sharding(
                 .lower(abs_variables, abs_batch)
                 .compile()
             )
-        collectives = parse_collectives(compiled.as_text())
-        findings.extend(check_collectives(collectives, allow, label=label))
+        return compiled, []
     except (ValueError, RuntimeError) as exc:
-        # A placement XLA itself rejects (XlaRuntimeError is a
-        # RuntimeError; sharding/mesh complaints are ValueErrors) — a
-        # finding, so one audit reports every bad rule. Anything else
-        # (TypeError from a mismatched step/batch pairing, etc.) is a
-        # caller bug and propagates as-is.
-        findings.append(Finding(
+        return None, [Finding(
             "RKT303", f"<spmd:{label}>", 0,
             f"axis-indivisible: GSPMD compilation failed under this rule "
             f"set: {str(exc).splitlines()[0][:300]}",
-        ))
+        )]
+
+
+def audit_sharding(
+    step_fn: Callable,
+    variables,
+    batch,
+    *,
+    rules: Callable[[Tuple[str, ...], Any], Spec],
+    mesh_shape: Mapping[str, int],
+    mesh: Optional[jax.sharding.Mesh] = None,
+    data_axes: Tuple[str, ...] = ("data",),
+    allow: Optional[Mapping[str, int]] = None,
+    replicated_bytes_limit: int = 1 << 20,
+    optimizer_slots: int = 2,
+    donate_argnums: Sequence[int] = (),
+    label: str = "step",
+) -> ShardAuditReport:
+    """Audit ``step_fn(variables, batch)`` under ``rules`` on a fake mesh.
+
+    ``variables`` / ``batch`` may be concrete arrays or
+    ``ShapeDtypeStruct``s (``jax.eval_shape(model.init, key)`` output is
+    the intended zero-FLOP path). The rules address the ``"params"``
+    subtree of ``variables`` when present (the ``Module`` convention),
+    the whole tree otherwise; batch leaves are sharded over ``data_axes``
+    on their leading dim when divisible, replicated otherwise.
+
+    Returns a :class:`ShardAuditReport`; ``report.record`` is the budget
+    record (:mod:`rocket_tpu.analysis.budgets`) and ``report.findings``
+    the RKT30x hits. Pure abstract evaluation + XLA compilation — no
+    FLOPs run, no params materialize, no TPU required.
+    """
+    if mesh is None:
+        mesh = _mesh_from_shape(mesh_shape)
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    abs_variables, abs_batch, specs, findings = resolve_placement(
+        variables, batch, rules=rules, mesh=mesh, data_axes=data_axes,
+        replicated_bytes_limit=replicated_bytes_limit, label=label,
+    )
+
+    collectives: list[CollectiveOp] = []
+    compiled, compile_findings = aot_compile_step(
+        step_fn, abs_variables, abs_batch, mesh=mesh,
+        donate_argnums=donate_argnums, label=label,
+    )
+    findings.extend(compile_findings)
+    if compiled is not None:
+        collectives = parse_collectives(compiled.as_text())
+        findings.extend(check_collectives(collectives, allow, label=label))
 
     hbm = estimate_hbm(
         specs, mesh_shape, optimizer_slots=optimizer_slots, compiled=compiled

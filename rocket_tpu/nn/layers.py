@@ -196,10 +196,18 @@ class BatchNorm(Layer):
             # keeps the cancellation error negligible at BN's post-conv
             # activation scales; the max() guards the tiny negative
             # residue cancellation can leave.
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0
+            #
+            # Both moments reduce as ONE stacked (2, C) reduction: under a
+            # data-sharded batch GSPMD then inserts a single cross-replica
+            # all-reduce of the (2, C) stats where separate mean/E[x^2]
+            # reductions cost two ~1us-latency collectives per BN layer
+            # per pass — sched_audit RKT501/RKT502 flagged the pairs on
+            # the dp_resnet_1x8 target (105 tiny all-reduces/step).
+            stats = jnp.mean(
+                jnp.stack([xf, jnp.square(xf)], axis=-1), axis=axes
             )
+            mean = stats[..., 0]
+            var = jnp.maximum(stats[..., 1] - jnp.square(mean), 0.0)
             m = self.momentum
             new_state = {
                 "mean": m * s["mean"] + (1 - m) * mean,

@@ -1,13 +1,16 @@
-"""Device peak-FLOPs table and MFU helpers (used by bench.py and the
-Profiler capsule)."""
+"""Device peak tables — MFU denominators and the roofline cost model's
+constants (used by bench.py, the Profiler capsule, and
+``analysis/sched_audit.py``)."""
 
 from __future__ import annotations
 
-from typing import Optional
+from dataclasses import dataclass
+from typing import Optional, Union
 
 import jax
 
-__all__ = ["PEAK_FLOPS", "peak_flops"]
+__all__ = ["PEAK_FLOPS", "peak_flops", "DeviceSpec", "DEVICE_SPECS",
+           "device_spec"]
 
 #: bf16 peak by device kind — MFU denominators. Matching is longest
 #: prefix, so "TPU v5 lite" (v5e) wins over "TPU v5" (v5p) and future
@@ -22,13 +25,72 @@ PEAK_FLOPS = {
 }
 
 
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Per-device-kind roofline constants.
+
+    ``flops_bf16`` matches :data:`PEAK_FLOPS`. ``hbm_bw`` and ``ici_bw``
+    are bytes/second — HBM read+write bandwidth and aggregate one-way
+    inter-chip bandwidth per chip (all links). ``vmem_bytes`` is a
+    CONSERVATIVE per-core scratch budget for pallas kernels, not the
+    hardware maximum — a kernel fitting this budget leaves the compiler
+    headroom for its own spills. ``ridge`` (FLOPs/byte) is the
+    arithmetic intensity above which a kernel is compute-bound.
+    """
+
+    kind: str
+    flops_bf16: float
+    hbm_bw: float
+    ici_bw: float
+    vmem_bytes: int
+
+    @property
+    def ridge(self) -> float:
+        return self.flops_bf16 / self.hbm_bw
+
+
+#: Roofline constants by device kind (same longest-prefix matching as
+#: PEAK_FLOPS). Bandwidths are the published per-chip figures; treat
+#: them as ranking constants for the static cost model, not measured
+#: achievable bandwidth.
+DEVICE_SPECS = {
+    spec.kind: spec
+    for spec in (
+        DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 16 << 20),
+        DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 16 << 20),  # v5e
+        DeviceSpec("TPU v5", 459e12, 2765e9, 600e9, 16 << 20),      # v5p
+        DeviceSpec("TPU v6 lite", 918e12, 1638e9, 448e9, 32 << 20),  # v6e
+        DeviceSpec("TPU v6", 918e12, 1638e9, 448e9, 32 << 20),
+        DeviceSpec("TPU v7", 2307e12, 7370e9, 1200e9, 32 << 20),
+    )
+}
+
+
+def _longest_prefix(table: dict, kind: str):
+    best = None
+    for prefix, value in table.items():
+        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
+            best = (len(prefix), value)
+    return None if best is None else best[1]
+
+
 def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     """bf16 peak for the device kind, or None when unknown (callers should
     omit MFU rather than compute it against the wrong peak)."""
     kind = (device or jax.devices()[0]).device_kind
     # Longest prefix wins ("TPU v5 lite" before "TPU v5").
-    best = None
-    for prefix, peak in PEAK_FLOPS.items():
-        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
-            best = (len(prefix), peak)
-    return None if best is None else best[1]
+    return _longest_prefix(PEAK_FLOPS, kind)
+
+
+def device_spec(
+    device: Optional[Union[jax.Device, str]] = None,
+) -> Optional[DeviceSpec]:
+    """Roofline constants for a device or device-kind string, or None
+    when the kind is unknown (callers should skip the roofline rather
+    than price against the wrong machine). Accepts the kind directly so
+    static auditors can price for hardware that is not present."""
+    if isinstance(device, str):
+        kind = device
+    else:
+        kind = (device or jax.devices()[0]).device_kind
+    return _longest_prefix(DEVICE_SPECS, kind)

@@ -30,6 +30,15 @@ echo "== precision audit (dtype-flow self-gate + numerics budgets) =="
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis prec \
     --budgets tests/fixtures/budgets/prec
 
+echo "== schedule audit (roofline self-gate + schedule budgets) =="
+# Roofline + two-stream simulation over the AOT-compiled steps; fails on
+# schedule findings (RKT501-505: exposed/convoyed collectives,
+# memory-bound critical paths, pallas block misfits, predicted-MFU
+# floors) or a >10% predicted-step-time / exposed-comm regression over
+# tests/fixtures/budgets/sched/.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
+    --budgets tests/fixtures/budgets/sched
+
 echo "== obs smoke (telemetry + health sentinels + strict step path) =="
 # Tier-1 example run with telemetry AND health sentinels on:
 # telemetry.json must exist and parse, goodput categories must sum to

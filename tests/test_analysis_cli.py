@@ -1,12 +1,15 @@
-"""The analysis CLI process contract, for all three entry forms.
+"""The analysis CLI process contract, for all four entry forms.
 
-``python -m rocket_tpu.analysis`` (rocketlint over paths),
-``... shard`` (the SPMD auditor) and ``... prec`` (the dtype-flow
-auditor) must hold the same machine contract CI scripts depend on: exit
-0 on a clean tree, 1 on findings, 2 on usage errors, and one
-``--format json`` output shape. Everything runs as a real subprocess
-under ``JAX_PLATFORMS=cpu`` — the audit subcommands provision their own
-fake 8-device backend, so no test fixture leaks into the contract.
+``python -m rocket_tpu.analysis`` (rocketlint over paths), ``... shard``
+(the SPMD auditor), ``... prec`` (the dtype-flow auditor) and
+``... sched`` (the roofline/schedule auditor) must hold the same machine
+contract CI scripts depend on: exit 0 on a clean tree, 1 on findings, 2
+on usage errors, and one ``--format json`` output shape. The audit
+subcommands share one registry (``__main__.AUDIT_SUBCOMMANDS``), so the
+contract rows are parameterized over it. Everything runs as a real
+subprocess under ``JAX_PLATFORMS=cpu`` — the audit subcommands provision
+their own fake 8-device backend, so no test fixture leaks into the
+contract.
 """
 
 import json
@@ -54,12 +57,29 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_four_families():
+def test_list_rules_includes_all_five_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
     for rule_id in ("RKT101", "RKT108", "RKT201", "RKT301", "RKT306",
-                    "RKT401", "RKT406"):
+                    "RKT401", "RKT406", "RKT501", "RKT506"):
         assert rule_id in proc.stdout
+
+
+# -- the shared audit-subcommand registry ------------------------------------
+
+def test_audit_registry_covers_every_subcommand():
+    """The registry IS the dispatch table: every audit CLI shares the
+    flag set and exit-code handling through it."""
+    from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
+
+    assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched"}
+
+
+@pytest.mark.parametrize("sub", ["shard", "prec", "sched"])
+def test_every_audit_subcommand_holds_the_usage_contract(sub):
+    assert run_cli(sub, "--target", "nope").returncode == 2
+    assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
+    assert run_cli(sub, "--list-targets").returncode == 0
 
 
 # -- shard form --------------------------------------------------------------
@@ -199,5 +219,76 @@ def test_shard_budget_regression_fails_and_rebaseline_clears(tmp_path):
         committed["collective_bytes_per_step"]
 
     proc = run_cli("shard", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- sched form --------------------------------------------------------------
+
+SCHED_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "sched")
+
+
+def test_sched_list_targets():
+    proc = run_cli("sched", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "dp_resnet_1x8",
+                 "tp_flash", "badsched", "badpallas"):
+        assert name in proc.stdout
+
+
+def test_sched_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: the repo's own steps roofline-simulated under
+    the committed schedule budgets — zero findings, exit 0."""
+    proc = run_cli("sched", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets", "sched"),
+                   timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_sched_badsched_reports_schedule_families():
+    """True positives through the real CLI: the seeded-bad schedule must
+    surface exposure, convoy, memory-bound and MFU-floor findings, exit
+    1, in the shared JSON shape."""
+    proc = run_cli("sched", "--target", "badsched", "--format", "json")
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert set(findings[0]) == {"rule", "path", "line", "message"}
+    rules = {f["rule"] for f in findings}
+    assert {"RKT501", "RKT502", "RKT503", "RKT505"} <= rules
+
+
+def test_sched_badpallas_reports_block_misfits():
+    proc = run_cli("sched", "--target", "badpallas", "--format", "json")
+    assert proc.returncode == 1
+    rules = {f["rule"] for f in json.loads(proc.stdout)}
+    assert rules == {"RKT504"}
+
+
+@pytest.mark.slow
+def test_sched_budget_regression_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: shrink the committed predicted step time by half
+    (equivalently: the prediction grew 2x) -> RKT506, exit 1;
+    --update-budgets re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "sched"
+    budgets_dir.mkdir()
+    committed = json.load(
+        open(os.path.join(SCHED_BUDGETS, "tp_2x4.json"))
+    )
+    committed["predicted_step_time_us"] = (
+        committed["predicted_step_time_us"] * 0.5
+    )
+    (budgets_dir / "tp_2x4.json").write_text(json.dumps(committed))
+
+    proc = run_cli("sched", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT506" in proc.stdout
+    assert "predicted_step_time_us" in proc.stdout
+
+    proc = run_cli("sched", "--target", "tp_2x4",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+
+    proc = run_cli("sched", "--target", "tp_2x4",
                    "--budgets", str(budgets_dir))
     assert proc.returncode == 0, proc.stdout + proc.stderr

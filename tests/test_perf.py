@@ -1,10 +1,16 @@
-"""utils/perf.py: the peak-FLOPs table and its longest-prefix matching."""
+"""utils/perf.py: the peak-FLOPs / roofline tables and their
+longest-prefix matching."""
 
 from types import SimpleNamespace
 
 import pytest
 
-from rocket_tpu.utils.perf import PEAK_FLOPS, peak_flops
+from rocket_tpu.utils.perf import (
+    DEVICE_SPECS,
+    PEAK_FLOPS,
+    device_spec,
+    peak_flops,
+)
 
 
 def _device(kind):
@@ -44,3 +50,32 @@ def test_new_generations_present_and_ordered():
     assert PEAK_FLOPS["TPU v7"] > PEAK_FLOPS["TPU v6"]
     # v5 lite < v5 (the prefix pair the matcher exists for).
     assert PEAK_FLOPS["TPU v5 lite"] < PEAK_FLOPS["TPU v5"]
+
+
+def test_device_spec_matches_peak_table_and_prefix_rules():
+    # Every roofline entry's bf16 peak agrees with PEAK_FLOPS, and the
+    # same longest-prefix matching applies ("TPU v5 lite" not "TPU v5").
+    for kind, spec in DEVICE_SPECS.items():
+        assert spec.flops_bf16 == PEAK_FLOPS[kind]
+        assert spec.kind == kind
+    assert device_spec(_device("TPU v5 lite")).kind == "TPU v5 lite"
+    assert device_spec("TPU v5p").kind == "TPU v5"
+    assert device_spec("TPU v6e").kind == "TPU v6"
+
+
+def test_device_spec_accepts_kind_string_and_rejects_unknown():
+    # The static auditors price hardware that is not present: the kind
+    # string is a first-class lookup; unknown kinds return None so the
+    # roofline is skipped, never priced against the wrong machine.
+    spec = device_spec("TPU v4")
+    assert spec.hbm_bw > 0 and spec.ici_bw > 0 and spec.vmem_bytes > 0
+    assert device_spec("cpu") is None
+    assert device_spec("TPU v3") is None
+
+
+def test_ridge_points_are_physical():
+    # Ridge = peak FLOPs / HBM bandwidth: every TPU generation sits in
+    # the hundreds of FLOPs/byte; bandwidth grows with the peak.
+    for spec in DEVICE_SPECS.values():
+        assert 100 < spec.ridge < 1000
+    assert DEVICE_SPECS["TPU v7"].hbm_bw > DEVICE_SPECS["TPU v4"].hbm_bw

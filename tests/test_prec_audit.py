@@ -439,3 +439,80 @@ def test_collect_dtype_flow_exposes_facts():
     assert dot.param_path == ("params", "w")
     assert in_dtypes[("params", "w")] == jnp.float32
     assert flow.narrow_casts == 1
+
+
+# -- RKT403 certification: deliberate low-precision collectives --------------
+
+def _lowprec_collective_parts():
+    from jax.sharding import PartitionSpec as P
+
+    from rocket_tpu.utils.compat import shard_map
+
+    mesh = jax.sharding.Mesh(jax.devices()[:8], ("d",))
+    vs = variables(w=sds((8, 8), jnp.float32))
+    batch = {"x": sds((8, 8), jnp.float32)}
+
+    def step(vs, batch):
+        # Deliberate compressed-gradient-style collective: the fp32
+        # master is narrowed to bf16 before crossing the mesh.
+        w16 = vs["params"]["w"].astype(jnp.bfloat16)
+        return shard_map(
+            lambda w: jax.lax.psum(w, "d"),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )(w16)
+
+    return step, vs, batch
+
+
+def test_certified_collective_passes_and_counts():
+    from rocket_tpu.analysis.prec_audit import certify_collectives
+
+    step, vs, batch = _lowprec_collective_parts()
+    certified = certify_collectives("params/w")(step)
+    report = audit_precision(certified, vs, batch, check_state=False)
+    assert report.findings == []
+    assert report.record["certified_collectives"] == 1
+
+
+def test_certification_kwarg_matches_decorator():
+    step, vs, batch = _lowprec_collective_parts()
+    report = audit_precision(
+        step, vs, batch, check_state=False,
+        certified_collectives=("params/*",),
+    )
+    assert report.findings == []
+
+
+def test_uncertified_collective_still_fires_with_hint():
+    step, vs, batch = _lowprec_collective_parts()
+    findings = audit_precision(step, vs, batch, check_state=False).findings
+    assert rules_in(findings) == ["RKT403"]
+    assert "certify_collectives" in findings[0].message
+
+
+def test_overlapping_certifications_both_count_as_used():
+    """A specific glob listed alongside a broader overlapping one must
+    not read as stale — every matching glob is credited."""
+    from rocket_tpu.analysis.prec_audit import certify_collectives
+
+    step, vs, batch = _lowprec_collective_parts()
+    certified = certify_collectives("params/*", "params/w")(step)
+    report = audit_precision(certified, vs, batch, check_state=False)
+    assert report.findings == []
+
+
+def test_stale_certification_is_a_finding():
+    """A glob that certifies nothing must flag — the certification list
+    is an exact audit trail, not a blanket suppression."""
+    from rocket_tpu.analysis.prec_audit import certify_collectives
+
+    step, vs, batch = _lowprec_collective_parts()
+    certified = certify_collectives(
+        "params/w", "params/no_such_param"
+    )(step)
+    findings = audit_precision(certified, vs, batch,
+                               check_state=False).findings
+    assert rules_in(findings) == ["RKT403"]
+    assert "no_such_param" in findings[0].message
+    assert "matched no" in findings[0].message

@@ -1,0 +1,419 @@
+"""sched_audit: HLO-schedule parsing, the roofline cost model, the
+two-stream simulation, per-RKT5xx-rule true positives and clean
+negatives, pallas fact collection, the schedule budget gate (RKT506)
+and the builtin self-gate / seeded-bad demo targets.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rocket_tpu.analysis import budgets
+from rocket_tpu.analysis.rules.sched_rules import (
+    check_convoys,
+    check_exposed_comm,
+    check_memory_bound,
+    check_mfu_floor,
+    check_pallas,
+)
+from rocket_tpu.analysis.sched_audit import (
+    SCHED_TARGETS,
+    OpCost,
+    PallasFact,
+    collect_pallas_facts,
+    cost_ops,
+    parse_hlo_module,
+    predict_compiled,
+    run_sched_target,
+    simulate,
+)
+from rocket_tpu.utils.perf import device_spec
+
+SPEC = device_spec("TPU v5 lite")
+
+
+def rules_in(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- HLO parsing -------------------------------------------------------------
+
+HLO = """\
+HloModule jit_step, is_scheduled=true, num_partitions=8
+
+%add.1 (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %a = f32[] add(f32[] %x, f32[] %y)
+}
+
+%fused_computation.1 (p0: f32[128,256], p1: f32[256,64]) -> f32[128,64] {
+  %p0 = f32[128,256]{1,0} parameter(0)
+  %p1 = f32[256,64]{1,0} parameter(1)
+  ROOT %d.i = f32[128,64]{1,0} dot(f32[128,256]{1,0} %p0, f32[256,64]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main_spmd (param.0: f32[128,256], param.1: f32[256,64]) -> f32[128,64] {
+  %param.0 = f32[128,256]{1,0} parameter(0), sharding={replicated}
+  %param.1 = f32[256,64]{1,0} parameter(1)
+  %dot.1 = f32[128,64]{1,0} dot(f32[128,256]{1,0} %param.0, f32[256,64]{1,0} %param.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/dot_general" source_file="/repo/nn/layers.py" source_line=66}
+  %fusion.1 = f32[128,64]{1,0} fusion(f32[128,256]{1,0} %param.0, f32[256,64]{1,0} %param.1), kind=kLoop, calls=%fused_computation.1
+  %bf.1 = bf16[128,64]{1,0} convert(f32[128,64]{1,0} %dot.1)
+  %dot.2 = bf16[128,64]{1,0} dot(bf16[128,64]{1,0} %bf.1, bf16[128,64]{1,0} %bf.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %all-reduce.0 = f32[128,64]{1,0} all-reduce(f32[128,64]{1,0} %fusion.1), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, use_global_device_ids=true, to_apply=%add.1
+  %all-gather-start.1 = (f32[128,64]{1,0}, f32[512,64]{1,0}) all-gather-start(f32[128,64]{1,0} %all-reduce.0), channel_id=2, replica_groups=[2,4]<=[8], dimensions={0}
+  %all-gather-done.1 = f32[512,64]{1,0} all-gather-done((f32[128,64]{1,0}, f32[512,64]{1,0}) %all-gather-start.1)
+  ROOT %slice.1 = f32[128,64]{1,0} slice(f32[512,64]{1,0} %all-gather-done.1), slice={[0:128], [0:64]}
+}
+"""
+
+
+def test_parse_hlo_module_entry_and_computations():
+    entry, comps = parse_hlo_module(HLO)
+    assert [i.name for i in entry] == [
+        "param.0", "param.1", "dot.1", "fusion.1", "bf.1", "dot.2",
+        "all-reduce.0", "all-gather-start.1", "all-gather-done.1",
+        "slice.1",
+    ]
+    assert "fused_computation.1" in comps and "add.1" in comps
+    by = {i.name: i for i in entry}
+    # Operands reference instructions only — called computations
+    # (calls=/to_apply=) must NOT leak into the operand list.
+    assert by["fusion.1"].operands == ("param.0", "param.1")
+    assert by["fusion.1"].called == ("fused_computation.1",)
+    assert by["all-reduce.0"].called == ("add.1",)
+    # Tuple-typed async result: bytes cover the tuple, shape is the
+    # first element's.
+    assert by["all-gather-start.1"].result_bytes == (128 * 64 + 512 * 64) * 4
+
+
+def test_cost_model_dot_flops_and_dtype_factor():
+    entry, comps = parse_hlo_module(HLO)
+    ops = {o.name: o for o in cost_ops(entry, comps, SPEC)}
+    # 2 * M * N * K, f32 dots at half the bf16 peak.
+    assert ops["dot.1"].flops == 2 * 128 * 64 * 256
+    assert ops["dot.2"].flops == 2 * 128 * 64 * 64
+    f32_time = ops["dot.1"].time_s
+    assert f32_time >= ops["dot.1"].flops / (SPEC.flops_bf16 * 0.5) - 1e-15
+    # Fusion FLOPs come from the called computation's dot.
+    assert ops["fusion.1"].flops == 2 * 128 * 64 * 256
+    # Parameters are free.
+    assert ops["param.0"].kind == "free"
+
+
+def test_cost_model_collectives_ring_bytes_and_async_done_free():
+    entry, comps = parse_hlo_module(HLO)
+    ops = {o.name: o for o in cost_ops(entry, comps, SPEC)}
+    ar = ops["all-reduce.0"]
+    assert ar.is_comm
+    result = 128 * 64 * 4
+    assert ar.comm_bytes == int(2 * (4 - 1) / 4 * result)
+    # iota-form replica groups ([2,4]<=[8] -> group size 4); async start
+    # costs the final tuple element, the done half is a free join.
+    ag = ops["all-gather-start.1"]
+    assert ag.comm_bytes == int((4 - 1) / 4 * (512 * 64 * 4))
+    assert ops["all-gather-done.1"].time_s == 0.0
+
+
+# -- the simulation ----------------------------------------------------------
+
+
+def mk_op(name, kind, time_s, operands=(), opcode=None, comm_bytes=0,
+          hbm_bytes=0, flops=0.0):
+    is_comm = kind == "comm"
+    return OpCost(
+        name=name, opcode=opcode or ("all-reduce" if is_comm else "fusion"),
+        kind=kind, time_s=time_s, flops=flops, hbm_bytes=hbm_bytes,
+        comm_bytes=comm_bytes, is_comm=is_comm, operands=tuple(operands),
+    )
+
+
+def test_sync_simulation_exposes_blocking_collective():
+    ops = [
+        mk_op("c", "comm", 10e-6, comm_bytes=1 << 20),
+        mk_op("a", "memory", 4e-6),
+        mk_op("b", "memory", 6e-6),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    sim = simulate(ops, overlap=False)
+    # Sync collective blocks: 10us exposed, then 12us of compute.
+    assert sim.makespan_s == pytest.approx(22e-6)
+    assert sim.exposed_comm_s == pytest.approx(10e-6)
+    assert sim.memory_bound_s == pytest.approx(12e-6)
+    # Attribution identity: makespan = compute + memory + exposed + stall.
+    assert sim.makespan_s == pytest.approx(
+        sim.compute_bound_s + sim.memory_bound_s + sim.exposed_comm_s
+        + sim.stall_s
+    )
+
+
+def test_dataflow_simulation_hides_collective_behind_independent_compute():
+    ops = [
+        mk_op("c", "comm", 10e-6, comm_bytes=1 << 20),
+        mk_op("a", "memory", 4e-6),
+        mk_op("b", "memory", 6e-6),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    ideal = simulate(ops, overlap=True)
+    # a/b (10us independent compute) hide the 10us collective entirely.
+    assert ideal.makespan_s == pytest.approx(12e-6)
+    assert ideal.exposed_comm_s == pytest.approx(0.0)
+
+
+def test_sync_collective_after_busy_compute_cannot_time_travel():
+    """A sync collective scheduled after compute is issued by the
+    in-order sequencer WHEN the stream reaches it — it must not float
+    back to its dependency time and cost nothing."""
+    ops = [
+        mk_op("a", "memory", 10e-6),
+        mk_op("c", "comm", 5e-6, comm_bytes=1 << 20),
+        mk_op("d", "memory", 1e-6, operands=("c",)),
+    ]
+    sim = simulate(ops, overlap=False)
+    assert sim.makespan_s == pytest.approx(16e-6)
+    assert sim.exposed_comm_s == pytest.approx(5e-6)
+
+
+def test_dataflow_simulation_keeps_structural_exposure():
+    # The collective feeds the ONLY compute op: nothing can hide it.
+    ops = [
+        mk_op("c", "comm", 10e-6, comm_bytes=1 << 20),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    ideal = simulate(ops, overlap=True)
+    assert ideal.exposed_comm_s == pytest.approx(10e-6)
+
+
+# -- RKT501 ------------------------------------------------------------------
+
+
+def test_exposed_comm_fires_only_on_hideable_exposure():
+    ops = [
+        mk_op("c", "comm", 50e-6, comm_bytes=8 << 20),
+        mk_op("a", "memory", 40e-6),
+        mk_op("b", "memory", 40e-6),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    sim = simulate(ops, overlap=False)
+    ideal = simulate(ops, overlap=True)
+    findings = check_exposed_comm(sim, ideal, label="t")
+    assert rules_in(findings) == ["RKT501"]
+    assert "could hide" in findings[0].message
+
+    # Structural-only exposure (no independent compute): silent.
+    ops2 = [
+        mk_op("c", "comm", 50e-6, comm_bytes=8 << 20),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    findings2 = check_exposed_comm(
+        simulate(ops2, overlap=False), simulate(ops2, overlap=True),
+        label="t",
+    )
+    assert findings2 == []
+
+
+# -- RKT502 ------------------------------------------------------------------
+
+
+def test_convoy_detection_and_gap_break():
+    tiny = [mk_op(f"c{i}", "comm", 1e-6, comm_bytes=1024)
+            for i in range(8)]
+    assert rules_in(check_convoys(tiny, label="t")) == ["RKT502"]
+
+    # A big compute op between them breaks the run below convoy_min.
+    split = tiny[:3] + [mk_op("f", "memory", 5e-6, hbm_bytes=1 << 20)] \
+        + tiny[3:6] + [mk_op("g", "memory", 5e-6, hbm_bytes=1 << 20)] \
+        + tiny[6:]
+    assert check_convoys(split, label="t") == []
+
+    # Tiny interleaved fusions (scalar fixups) do NOT break the convoy.
+    laced = []
+    for i, op in enumerate(tiny):
+        laced.append(op)
+        laced.append(mk_op(f"s{i}", "memory", 1e-9, hbm_bytes=256))
+    assert rules_in(check_convoys(laced, label="t")) == ["RKT502"]
+
+    # Large-payload collectives are bandwidth-, not latency-bound.
+    big = [mk_op(f"c{i}", "comm", 100e-6, comm_bytes=64 << 20)
+           for i in range(8)]
+    assert check_convoys(big, label="t") == []
+
+
+# -- RKT503 ------------------------------------------------------------------
+
+
+def test_memory_bound_gate_and_small_op_exemption():
+    heavy = [mk_op(f"m{i}", "memory", 30e-6, hbm_bytes=4 << 20)
+             for i in range(3)]
+    light = [mk_op("x", "compute", 10e-6, flops=1e9)]
+    findings = check_memory_bound(
+        heavy + light, makespan_s=100e-6, ridge=SPEC.ridge, label="t"
+    )
+    assert rules_in(findings) == ["RKT503"]
+
+    # The same time spent in SMALL memory-bound ops is policy, not a
+    # hazard (tiny models are all memory-bound).
+    small = [mk_op(f"m{i}", "memory", 30e-6, hbm_bytes=1 << 10)
+             for i in range(3)]
+    assert check_memory_bound(
+        small + light, makespan_s=100e-6, ridge=SPEC.ridge, label="t"
+    ) == []
+
+
+# -- RKT504 ------------------------------------------------------------------
+
+
+def _fact(blocks, full=None, vmem=0):
+    return PallasFact(
+        name="k", grid=(4,), blocks=tuple(blocks),
+        full_shapes=full or {}, vmem_bytes_est=vmem,
+    )
+
+
+def test_pallas_alignment_and_vmem_checks():
+    aligned = _fact([(((16, 128)), "float32")], vmem=1 << 20)
+    assert check_pallas([aligned], SPEC.vmem_bytes) == []
+
+    misaligned = _fact([((7, 100), "float32")])
+    findings = check_pallas([misaligned], SPEC.vmem_bytes)
+    assert rules_in(findings) == ["RKT504"]
+    assert "% 128" in findings[0].message
+
+    # bf16 sublane minimum is 16: an 8-sublane bf16 block misfits.
+    bf16 = _fact([((8, 128), "bfloat16")])
+    assert rules_in(check_pallas([bf16], SPEC.vmem_bytes)) == ["RKT504"]
+
+    # Full-dimension blocks are exempt from the lane rule (mosaic allows
+    # block == whole array dim).
+    full = _fact(
+        [((8, 100), "float32")],
+        full={((8, 100), "float32"): (64, 100)},
+    )
+    assert check_pallas([full], SPEC.vmem_bytes) == []
+
+    over = _fact([((8, 128), "float32")], vmem=SPEC.vmem_bytes + 1)
+    findings = check_pallas([over], SPEC.vmem_bytes)
+    assert rules_in(findings) == ["RKT504"]
+    assert "VMEM" in findings[0].message
+
+
+def test_collect_pallas_facts_from_traced_step():
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def step(variables, batch):
+        x = batch["x"]
+        return variables, pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            grid=(2,),
+            in_specs=[pl.BlockSpec((128, 256), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((128, 256), lambda i: (i, 0)),
+        )(x).sum()
+
+    variables = {"params": {}, "state": {}}
+    batch = {"x": jax.ShapeDtypeStruct((256, 256), jnp.float32)}
+    facts = collect_pallas_facts(step, variables, batch)
+    assert len(facts) == 1
+    assert facts[0].grid == (2,)
+    assert ((128, 256), "float32") in facts[0].blocks
+    # in + out double-buffered: 2 * 2 * 128*256*4
+    assert facts[0].vmem_bytes_est == 2 * 2 * 128 * 256 * 4
+
+
+# -- RKT505 ------------------------------------------------------------------
+
+
+def test_mfu_floor():
+    assert check_mfu_floor(0.5, 0.4) == []
+    assert check_mfu_floor(None, 0.4) == []
+    assert check_mfu_floor(0.5, 0.0) == []
+    assert rules_in(check_mfu_floor(0.3, 0.4)) == ["RKT505"]
+
+
+# -- RKT506: the schedule budget gate ----------------------------------------
+
+
+def test_sched_budget_diff_gates_step_time_and_exposure(tmp_path):
+    record = {"predicted_step_time_us": 100.0, "exposed_comm_us": 40.0}
+    budgets.write_budget(str(tmp_path), "t", record)
+    committed = budgets.load_budget(str(tmp_path), "t")
+
+    grown = {"predicted_step_time_us": 120.0, "exposed_comm_us": 40.0}
+    findings = budgets.diff_budget(
+        "t", committed, grown, keys=budgets.SCHED_GATED_KEYS,
+        rule="RKT506", family="sched",
+    )
+    assert rules_in(findings) == ["RKT506"]
+    assert findings[0].path == "<sched:t>"
+
+    shrunk = {"predicted_step_time_us": 80.0, "exposed_comm_us": 20.0}
+    assert budgets.diff_budget(
+        "t", committed, shrunk, keys=budgets.SCHED_GATED_KEYS,
+        rule="RKT506", family="sched",
+    ) == []
+
+
+# -- builtin targets ---------------------------------------------------------
+
+
+def test_builtin_self_gate_targets_are_clean():
+    """THE acceptance gate: the repo's own steps on the repo's own rule
+    sets, roofline-simulated — zero findings, and every compiled target
+    attributes its predicted step time."""
+    for name in ("tp_2x4", "fsdp_1x8", "tp_2x4_eval"):
+        report = run_sched_target(SCHED_TARGETS[name])
+        assert report.findings == [], (name, report.findings)
+        fr = report.record["fractions"]
+        assert set(fr) == {"compute", "memory", "exposed_comm", "stall"}
+        assert sum(fr.values()) == pytest.approx(1.0, abs=0.01)
+        for key in budgets.SCHED_GATED_KEYS:
+            assert report.record[key] >= 0
+
+
+def test_resnet_target_counts_conv_flops_and_bn_collectives():
+    report = run_sched_target(SCHED_TARGETS["dp_resnet_1x8"])
+    assert report.findings == [], report.findings
+    # Conv FLOPs dominate: a CIFAR ResNet-18 fwd+bwd step at B=64 is
+    # ~3 * 2 * 0.56 GMACs/sample * 64 — the parser must see them.
+    assert report.record["flops_per_step"] > 1e10
+    # Sync-BN: ONE stacked stats all-reduce per BN layer in forward
+    # (the fused-moments fix this auditor motivated), not two.
+    assert report.record["n_collectives"] < 120
+
+
+def test_flash_target_audits_real_kernels_jaxpr_only():
+    report = run_sched_target(SCHED_TARGETS["tp_flash"])
+    assert report.findings == [], report.findings
+    assert report.record == {}  # jaxpr-only: no HLO, no budget record
+    assert len(report.pallas) >= 2  # fwd + bwd kernels
+    assert all(fact.blocks for fact in report.pallas)
+
+
+def test_badsched_demo_reports_schedule_families():
+    report = run_sched_target(SCHED_TARGETS["badsched"])
+    assert {"RKT501", "RKT502", "RKT503", "RKT505"} <= set(
+        rules_in(report.findings)
+    )
+
+
+def test_badpallas_demo_reports_block_misfits():
+    report = run_sched_target(SCHED_TARGETS["badpallas"])
+    assert rules_in(report.findings) == ["RKT504"]
+    messages = " ".join(f.message for f in report.findings)
+    assert "% 128" in messages and "VMEM" in messages
+
+
+def test_predict_compiled_rejects_unknown_device_kind():
+    with pytest.raises(ValueError):
+        predict_compiled(HLO, device_kind="TPU v99")
+
+
+def test_predict_compiled_record_shape_on_snippet():
+    scheduled, ideal, record = predict_compiled(HLO)
+    assert record["n_collectives"] == 2
+    assert record["predicted_step_time_us"] > 0
+    assert ideal.makespan_s <= scheduled.makespan_s + 1e-12
+    assert record["device_kind"] == "TPU v5 lite"
