@@ -928,7 +928,78 @@ def health_summary(warmup=10, steps=60, batch=1024):
         return None
 
 
-def write_detail(results, path=DETAIL_PATH, health=None):
+def serve_summary(requests=64, warmup_requests=8):
+    """Steady-state serving throughput + latency percentiles for
+    BENCH_DETAIL.json (``rocket_tpu.serve``).
+
+    A char-LM-sized model serves a synthetic continuous-batching workload
+    (mixed prompt/generation lengths, greedy) on ONE engine: a small
+    warmup batch pays the two compiles, ``reset_metrics()`` zeroes the
+    latency aggregates (jit caches are per-engine, so the warmup must run
+    on the SAME engine), then the measured batch reflects steady-state
+    serving with no compile time in the percentiles. Records tokens/sec,
+    TTFT/ITL percentiles, the compiled-once counters and the pool/slot
+    shape. Best effort: None on any failure — emission must never die on
+    serving."""
+    try:
+        import numpy as np
+
+        from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+        from rocket_tpu.serve import ServeConfig, ServeEngine
+
+        config = TransformerConfig(
+            vocab_size=128, max_seq_len=256, dim=256, num_layers=6,
+            num_heads=4, dropout=0.0, activation_dtype="bfloat16",
+        )
+        model = TransformerLM(config)
+        params = jax.jit(model.init)(jax.random.key(0))["params"]
+        serve_cfg = ServeConfig(
+            max_slots=8, block_len=16, prefill_chunk=32, max_model_len=256
+        )
+
+        def run(engine, n, seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(n):
+                plen = int(rng.integers(1, 65))
+                engine.submit(
+                    rng.integers(0, 128, size=plen).astype(np.int32),
+                    max_new_tokens=int(rng.integers(8, 65)),
+                    temperature=0.0,
+                )
+            engine.drain()
+            return engine.report()
+
+        engine = ServeEngine(model, params, serve_cfg)
+        run(engine, warmup_requests, 1)
+        engine.reset_metrics()
+        report = run(engine, requests, 2)
+
+        def _ms(block):
+            return {
+                k: round(v * 1e3, 3)
+                for k, v in (block or {}).items() if k != "count"
+            }
+
+        return {
+            "config": "charlm_256",
+            "requests": requests,
+            "tokens_generated": report["tokens_generated"],
+            "tokens_per_sec": round(report["tokens_per_sec"], 1),
+            "ttft_ms": _ms(report["time_to_first_token_s"]),
+            "itl_ms": _ms(report["inter_token_latency_s"]),
+            "decode_traces": report["compiled"]["decode_traces"],
+            "prefill_traces": report["compiled"]["prefill_traces"],
+            "occupancy_mean": round(report["slots"]["occupancy_mean"], 2),
+            "kv_pool_mib": round(
+                report["pool"]["kv_pool_bytes"] / 2**20, 1
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 — best-effort, like the audits
+        log(f"bench: serve_summary failed: {exc!r}")
+        return None
+
+
+def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
     this file is the complete record it points at.
@@ -988,6 +1059,11 @@ def write_detail(results, path=DETAIL_PATH, health=None):
         # the in-step sentinels + lax.cond gate on vs off, plus the
         # probe's anomaly/skip accounting. Target: overhead_frac < 0.02.
         detail["health_sentinels"] = health
+    if serve is not None:
+        # Steady-state serving metrics (rocket_tpu.serve): continuous-
+        # batching tokens/sec + TTFT/ITL percentiles on the char-LM-sized
+        # model, with the compiled-once trace counters alongside.
+        detail["serve"] = serve
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).
@@ -1111,13 +1187,22 @@ def main():
         if health is not None:
             log(f"bench: health_summary -> {health}")
 
+    # Serving throughput/latency probe (rocket_tpu.serve) — same budget
+    # discipline as the health probe: never eats headline time.
+    serve = None
+    if time.time() - start <= args.budget_s:
+        log("bench: serve continuous-batching probe ...")
+        serve = serve_summary()
+        if serve is not None:
+            log(f"bench: serve_summary -> {serve}")
+
     # The stdout line is the hard contract and goes out FIRST — a kill or
     # hang during the best-effort detail write must not eat it. It still
     # ends up last in the tail capture because nothing else prints to
     # stdout after it.
     print(format_line(results), flush=True)
     try:
-        write_detail(results, health=health)
+        write_detail(results, health=health, serve=serve)
     except Exception as exc:  # noqa: BLE001 — detail file is best effort
         log(f"bench: could not write {DETAIL_PATH}: {exc!r}")
 

@@ -1,0 +1,123 @@
+"""Token-sampling core shared by ``generate()`` and ``rocket_tpu.serve``.
+
+One implementation of temperature / top-k / top-p sampling and the
+EOS-freeze step, accepting either Python scalars (the ``generate()`` path
+— compiled per knob combination, op-for-op identical to the historical
+``_sample_token``) or per-row arrays (the serving path, where every slot
+in a fixed-shape decode wave carries its own sampling parameters and the
+knobs must be runtime values so admission never retraces).
+
+Conventions for the per-row (array) forms:
+
+* ``temperature <= 0`` — greedy argmax for that row;
+* ``top_k <= 0`` — no top-k filter for that row;
+* ``top_p >= 1`` — no nucleus filter for that row;
+* ``eos < 0`` — EOS freezing disabled for that row (frozen rows fill
+  with 0 when they hit a length limit instead).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens", "freeze_after_eos"]
+
+
+def _scalar(value) -> bool:
+    """Python OR numpy scalar (ndim-0) — routed to the static path; jax
+    arrays (even 0-d) and per-row numpy arrays take the runtime path."""
+    return isinstance(value, (int, float, np.integer, np.floating))
+
+
+def sample_tokens(logits, key, salt, temperature, top_k=None, top_p=None):
+    """Sample next tokens from ``logits`` (..., V).
+
+    ``temperature``/``top_k``/``top_p`` may each be a Python scalar
+    (static — baked into the compiled fn, exactly the historical
+    ``generate()`` behavior) or a per-row array over the leading dims
+    (runtime — one compiled fn serves every knob combination). ``salt`` is
+    folded into ``key``: a scalar derives ONE subkey shared across the
+    batch (the ``generate()`` convention, so both its paths sample
+    identically for the same key), an array derives per-row subkeys (the
+    serve convention: each slot streams independent of its neighbors).
+    """
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+
+    if top_k is not None:
+        if _scalar(top_k):
+            kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        else:
+            k = jnp.asarray(top_k, jnp.int32)
+            ranked = jnp.sort(logits, axis=-1)[..., ::-1]
+            kth = jnp.take_along_axis(
+                ranked, (jnp.clip(k, 1, vocab) - 1)[..., None], axis=-1
+            )
+            logits = jnp.where(
+                (k[..., None] > 0) & (logits < kth), -jnp.inf, logits
+            )
+
+    static_temp = _scalar(temperature)
+    if static_temp and temperature <= 0:
+        return jnp.argmax(logits, axis=-1)  # filters don't move the argmax
+    if static_temp:
+        scaled = logits / temperature
+    else:
+        t = jnp.asarray(temperature, jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.where(t > 0, t, 1.0)[..., None]
+
+    if top_p is not None and not (_scalar(top_p) and top_p >= 1.0):
+        # Nucleus: keep the smallest descending-prob prefix whose mass
+        # reaches top_p (the first token always survives: cum - p < top_p).
+        ranked = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(ranked, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        if _scalar(top_p):
+            keep = cum - probs < float(top_p)
+            cutoff = jnp.min(
+                jnp.where(keep, ranked, jnp.inf), axis=-1, keepdims=True
+            )
+        else:
+            p = jnp.asarray(top_p, jnp.float32)[..., None]
+            keep = cum - probs < p
+            cutoff = jnp.min(
+                jnp.where(keep, ranked, jnp.inf), axis=-1, keepdims=True
+            )
+            cutoff = jnp.where(p < 1.0, cutoff, -jnp.inf)  # row opt-out
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    if getattr(salt, "ndim", 0) > 0:
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            key, jnp.asarray(salt)
+        )
+        sampled = jax.vmap(
+            lambda k_row, l_row: jax.random.categorical(k_row, l_row)
+        )(keys, scaled)
+    else:
+        sampled = jax.random.categorical(
+            jax.random.fold_in(key, salt), scaled, axis=-1
+        )
+    if static_temp:
+        return sampled
+    return jnp.where(t > 0, sampled, greedy)
+
+
+def freeze_after_eos(nxt, done, eos):
+    """Force the fill token for rows whose carried ``done`` flag is set
+    (they GENERATED an EOS or hit their length limit on an earlier step —
+    prompt EOS never sets the flag), and fold this step's token into the
+    flag. ``eos`` is a Python int (always enabled — the legacy scalar
+    path) or a per-row int array where ``< 0`` disables EOS for that row
+    (such rows fill with 0 once frozen). O(B) per step."""
+    if isinstance(eos, int):
+        nxt = jnp.where(done, eos, nxt)
+        return nxt, done | (nxt == eos)
+    eos = jnp.asarray(eos, nxt.dtype)
+    enabled = eos >= 0
+    nxt = jnp.where(done, jnp.where(enabled, eos, 0), nxt)
+    return nxt, done | (enabled & (nxt == eos))

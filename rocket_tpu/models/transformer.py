@@ -403,6 +403,24 @@ class Block(Layer):
             h = self._mlp(params["mlp"], h)
         return x + h, cache
 
+    def apply_paged(self, params, x, k_pages, v_pages, block_table,
+                    positions, valid):
+        """Decode/prefill chunk through the block against an EXTERNAL
+        paged KV pool (``rocket_tpu.serve``): ``x`` (S, C, D) at per-slot
+        global positions (eval semantics — no dropout). Returns
+        ``(y, k_pages', v_pages')``."""
+        h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
+        h, k_pages, v_pages = self.attn.apply_paged(
+            params["attn"], h, k_pages, v_pages, block_table, positions, valid
+        )
+        x = x + h
+        h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
+        if self.moe is not None:
+            h, _ = self.moe.apply({"params": params["moe"], "state": {}}, h)
+        else:
+            h = self._mlp(params["mlp"], h)
+        return x + h, k_pages, v_pages
+
     def _mlp(self, p, h):
         up, _ = self.fc_in.apply({"params": p["fc_in"], "state": {}}, h)
         if self.mlp_type == "swiglu":
@@ -533,6 +551,64 @@ class TransformerLM(Model):
         else:
             logits = jnp.einsum("btd,vd->btv", x, p["wte"]["table"].astype(x.dtype))
         return logits[:, 0], caches
+
+    def decode_step_paged(self, params, tokens, k_pages, v_pages,
+                          block_table, positions, valid):
+        """Decode/prefill chunk against an EXTERNAL paged KV pool — the
+        cache is indexed by slot, not owned by the call
+        (``rocket_tpu.serve``; pool layout in ``ops/paged_attention.py``).
+
+        ``tokens`` (S, C) int32 — slot ``s``'s chunk occupies global
+        positions ``[positions[s], positions[s]+C)`` with the first
+        ``valid[s]`` rows real; ``k_pages``/``v_pages`` are the per-layer
+        stacked pool ``(L, NB, BL, Hkv, D)``; ``block_table`` (S, MB) maps
+        slot positions onto pool blocks. Returns ``(logits (S, V) of the
+        chunk's LAST position, k_pages', v_pages')`` — C=1 is the decode
+        wave, C=chunk the prefill step, one code path for both.
+        """
+        p = params
+        s, c = tokens.shape
+        x = jnp.take(p["wte"]["table"], tokens, axis=0)
+        if self.wpe is not None:
+            pos_ids = jnp.clip(
+                positions[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :],
+                0, self.config.max_seq_len - 1,
+            )
+            x = x + jnp.take(p["wpe"]["table"], pos_ids, axis=0)
+        if self.config.activation_dtype is not None:
+            x = x.astype(self.config.activation_dtype)
+
+        if self.config.scan_layers:
+            block = self.blocks[0]
+
+            def body(h, xs):
+                params_i, kp, vp = xs
+                h, kp, vp = block.apply_paged(
+                    params_i, h, kp, vp, block_table, positions, valid
+                )
+                return h, (kp, vp)
+
+            x, (k_pages, v_pages) = jax.lax.scan(
+                body, x, (p["blocks_stacked"], k_pages, v_pages)
+            )
+        else:
+            for i, block in enumerate(self.blocks):
+                x, kp, vp = block.apply_paged(
+                    p["blocks"][str(i)], x, k_pages[i], v_pages[i],
+                    block_table, positions, valid,
+                )
+                k_pages = k_pages.at[i].set(kp)
+                v_pages = v_pages.at[i].set(vp)
+
+        x = x[:, -1:]  # only the last position's logits are consumed
+        x, _ = self.ln_f.apply({"params": p["ln_f"], "state": {}}, x)
+        if self.head is not None:
+            logits, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+        else:
+            logits = jnp.einsum(
+                "btd,vd->btv", x, p["wte"]["table"].astype(x.dtype)
+            )
+        return logits[:, 0], k_pages, v_pages
 
     def _resolve_pipe_mesh(self):
         """Pin the pipeline mesh at first trace (same rule as ring/flash
@@ -929,13 +1005,13 @@ def generate(
     model: TransformerLM,
     variables: Variables,
     prompt_tokens,
-    max_new_tokens: int,
+    max_new_tokens,
     *,
     key=None,
     temperature: float = 1.0,
-    top_k: int = None,
-    top_p: float = None,
-    eos_token_id: int = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
     use_cache: bool = True,
 ):
     """Autoregressive sampling from a trained LM, as ONE compiled loop.
@@ -961,21 +1037,43 @@ def generate(
     ``eos_token_id``: once a sequence samples EOS, every later position is
     forced to EOS (the loop stays a fixed-trip compiled scan; finished
     sequences just stop changing).
+
+    ``max_new_tokens`` and ``eos_token_id`` may each also be a per-sequence
+    array of length B (``rocket_tpu.serve`` parity — both paths share the
+    sampling core in ``models/sampling.py``): the loop runs to the LONGEST
+    limit and sequences that hit their own limit freeze early, filling
+    with their EOS (or 0 where eos is absent/-1). Per-sequence values are
+    runtime arrays, not compile-time constants — varying them never
+    recompiles the loop.
+
     Per-step sample keys are derived with ``fold_in(key, position)``, so
     both paths produce identical samples for the same key. Returns
-    (B, prompt_len + max_new_tokens) int32.
+    (B, prompt_len + max(max_new_tokens)) int32.
     """
+    import numpy as np
+
     if use_cache and model.config.attention_impl == "ring":
         use_cache = False  # see docstring — no dense KV cache to fill
     prompt = jnp.asarray(prompt_tokens, jnp.int32)
     if prompt.ndim == 1:
         prompt = prompt[None, :]
     b, start = prompt.shape
-    total = start + max_new_tokens
+    if np.ndim(max_new_tokens) == 0:  # python OR numpy integer scalar
+        per_seq_new = np.full((b,), int(max_new_tokens), np.int32)
+    else:
+        per_seq_new = np.asarray(max_new_tokens, np.int32)
+        if per_seq_new.shape != (b,):
+            raise ValueError(
+                f"generate: per-sequence max_new_tokens must have shape "
+                f"({b},), got {per_seq_new.shape}"
+            )
+        if (per_seq_new < 0).any():
+            raise ValueError("generate: max_new_tokens must be >= 0")
+    total = start + int(per_seq_new.max())
     if total > model.config.max_seq_len:
         raise ValueError(
-            f"generate: prompt {start} + new {max_new_tokens} tokens exceed "
-            f"max_seq_len {model.config.max_seq_len}"
+            f"generate: prompt {start} + new {int(per_seq_new.max())} tokens "
+            f"exceed max_seq_len {model.config.max_seq_len}"
         )
     if temperature > 0 and key is None:
         raise ValueError("generate: sampling (temperature > 0) needs a PRNG key")
@@ -983,45 +1081,30 @@ def generate(
         # top_p <= 0 would mask EVERY token to -inf and categorical() would
         # silently emit token 0 forever.
         raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
+    if eos_token_id is None:
+        eos_vec = np.full((b,), -1, np.int32)
+    elif np.ndim(eos_token_id) == 0:  # python OR numpy integer scalar
+        eos_vec = np.full((b,), int(eos_token_id), np.int32)
+    else:
+        eos_vec = np.asarray(eos_token_id, np.int32)
+        if eos_vec.shape != (b,):
+            raise ValueError(
+                f"generate: per-sequence eos_token_id must have shape "
+                f"({b},), got {eos_vec.shape}"
+            )
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :start].set(prompt)
     key = jax.random.key(0) if key is None else key
     run = _generate_fn(
-        model, start, total, float(temperature), top_k,
+        model, start, total, float(temperature),
+        None if top_k is None else int(top_k),
         None if top_p is None else float(top_p),
-        None if eos_token_id is None else int(eos_token_id),
         use_cache,
     )
-    return run(variables["params"], buf, key)
-
-
-def _sample_token(logits, key, i, temperature, top_k, top_p):
-    logits = logits.astype(jnp.float32)
-    if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if temperature <= 0:
-        return jnp.argmax(logits, axis=-1)  # filters don't move the argmax
-    logits = logits / temperature
-    if top_p is not None and top_p < 1.0:
-        # Nucleus: keep the smallest descending-prob prefix whose mass
-        # reaches top_p (the first token always survives: cum - p < top_p).
-        sl = jnp.sort(logits, axis=-1)[..., ::-1]
-        ps = jax.nn.softmax(sl, axis=-1)
-        cum = jnp.cumsum(ps, axis=-1)
-        keep = cum - ps < top_p
-        cutoff = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    sub = jax.random.fold_in(key, i)
-    return jax.random.categorical(sub, logits, axis=-1)
-
-
-def _freeze_after_eos(nxt, done, eos):
-    """Force EOS for sequences whose carried ``done`` flag is set (they
-    GENERATED an EOS on an earlier step — prompt EOS never sets it), and
-    fold this step's token into the flag. O(B) per step."""
-    nxt = jnp.where(done, eos, nxt)
-    return nxt, done | (nxt == eos)
+    # Absolute end position per sequence — a runtime arg (with eos_vec), so
+    # per-request values never key the compile cache.
+    limits = jnp.asarray(start + per_seq_new, jnp.int32)
+    return run(variables["params"], buf, key, jnp.asarray(eos_vec), limits)
 
 
 def _decode_params(params, activation_dtype):
@@ -1044,15 +1127,18 @@ def _decode_params(params, activation_dtype):
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache):
+def _generate_fn(model, start, total, temperature, top_k, top_p, use_cache):
     """Jitted generation loop, cached by (model, window, sampling knobs) —
     a fresh closure per generate() call would retrace and recompile the
-    whole model every invocation."""
+    whole model every invocation. Per-sequence EOS ids and length limits
+    enter as runtime arrays (``eos_vec``: -1 = no EOS for that row;
+    ``limits``: absolute end positions), so they never key this cache."""
+    from rocket_tpu.models.sampling import freeze_after_eos, sample_tokens
 
     if use_cache:
 
         @jax.jit
-        def run(params, buf, key):
+        def run(params, buf, key, eos_vec, limits):
             params = _decode_params(params, model.config.activation_dtype)
             dtype = jnp.dtype(model.config.activation_dtype or jnp.float32)
             caches = model.init_cache(buf.shape[0], total, dtype)
@@ -1062,13 +1148,13 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
                 params, buf[:, :start], caches, 0
             )
 
-            done0 = jnp.zeros((buf.shape[0],), bool)
+            done0 = start >= limits
 
             def body(i, carry):
                 buf, caches, logits, done = carry
-                nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
-                if eos is not None:
-                    nxt, done = _freeze_after_eos(nxt, done, eos)
+                nxt = sample_tokens(logits, key, i, temperature, top_k, top_p)
+                nxt, done = freeze_after_eos(nxt, done, eos_vec)
+                done = done | (i + 1 >= limits)
                 buf = buf.at[:, i].set(nxt.astype(jnp.int32))
                 tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
                 logits, caches = model.decode_step(params, tok, caches, i)
@@ -1082,7 +1168,7 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
         return run
 
     @jax.jit
-    def run(params, buf, key):
+    def run(params, buf, key, eos_vec, limits):
         params = _decode_params(params, model.config.activation_dtype)
 
         def body(i, carry):
@@ -1094,12 +1180,12 @@ def _generate_fn(model, start, total, temperature, top_k, top_p, eos, use_cache)
             logits = jax.lax.dynamic_index_in_dim(
                 out[model.logits_key], i - 1, axis=1, keepdims=False
             )
-            nxt = _sample_token(logits, key, i, temperature, top_k, top_p)
-            if eos is not None:
-                nxt, done = _freeze_after_eos(nxt, done, eos)
+            nxt = sample_tokens(logits, key, i, temperature, top_k, top_p)
+            nxt, done = freeze_after_eos(nxt, done, eos_vec)
+            done = done | (i + 1 >= limits)
             return buf.at[:, i].set(nxt.astype(jnp.int32)), done
 
-        done0 = jnp.zeros((buf.shape[0],), bool)
+        done0 = start >= limits
         buf, _ = jax.lax.fori_loop(start, total, body, (buf, done0))
         return buf
 

@@ -29,6 +29,7 @@ __all__ = [
     "MultiHeadAttention",
     "apply_rope",
     "apply_rope_bthd",
+    "apply_rope_offsets",
     "dot_product_attention",
     "grouped_dot_product_attention",
     "resolve_impl",
@@ -187,6 +188,26 @@ def apply_rope_bthd(x: jax.Array, offset=0, base: float = 10000.0) -> jax.Array:
     cos, sin = _rope_trig(x.shape[1], x.shape[-1] // 2, offset, base)
     # (T, 1, half) — broadcasts over the H dim.
     return _rope_rotate(x, cos[:, None, :], sin[:, None, :])
+
+
+def apply_rope_offsets(x: jax.Array, offsets: jax.Array,
+                       base: float = 10000.0) -> jax.Array:
+    """:func:`apply_rope_bthd` with a PER-ROW position offset: ``x`` is
+    feature-major (B, T, H, D) and row ``b``'s positions are
+    ``offsets[b] .. offsets[b]+T`` — the paged-decode layout, where every
+    serving slot sits at its own sequence position. Same rotate-half
+    convention and f32 trig."""
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = (
+        offsets[:, None].astype(jnp.float32)
+        + jnp.arange(x.shape[1], dtype=jnp.float32)[None, :]
+    )
+    angles = pos[..., None] * freqs                      # (B, T, half)
+    # (B, T, 1, half) — broadcasts over the H dim.
+    return _rope_rotate(
+        x, jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    )
 
 
 def grouped_dot_product_attention(
@@ -547,6 +568,44 @@ class MultiHeadAttention(Layer):
         out = jnp.moveaxis(out, 1, 2).reshape(b, s, self.features)
         out, _ = self.proj.apply({"params": params["proj"], "state": {}}, out)
         return out, {"k": k_cache, "v": v_cache}
+
+    def apply_paged(self, params, x, k_pages, v_pages, block_table,
+                    positions, valid):
+        """Paged-pool decode/prefill chunk: ``x`` (S, C, D) — slot ``s``'s
+        chunk sits at global positions ``[positions[s], positions[s]+C)``
+        and only its first ``valid[s]`` rows are real (padding rows write
+        to the pool's trash block and their outputs are garbage the caller
+        ignores). K/V rows are scattered into the shared block pool via
+        ``block_table`` and attention runs causally over the gathered
+        prefix (``ops/paged_attention.py``). Eval semantics — no dropout.
+        Returns ``(out (S, C, D), k_pages', v_pages')``.
+
+        Stays feature-major end to end (no (B, H, T, D) transposes), and
+        under GQA the pool holds Hkv heads — the same cache shrink as
+        :meth:`init_cache`."""
+        from rocket_tpu.ops.paged_attention import paged_attention
+
+        s, c, _ = x.shape
+        fused, _ = self.qkv.apply({"params": params["qkv"], "state": {}}, x)
+        hw = self.num_heads * self.head_dim
+        kvw = self.num_kv_heads * self.head_dim
+        q2 = fused[..., :hw].reshape(s, c, self.num_heads, self.head_dim)
+        k2 = fused[..., hw:hw + kvw].reshape(
+            s, c, self.num_kv_heads, self.head_dim
+        )
+        v2 = fused[..., hw + kvw:].reshape(
+            s, c, self.num_kv_heads, self.head_dim
+        )
+        if self.rope:
+            # Per-slot absolute positions; keys enter the pool already
+            # rotated, so cached rows never need re-rotation.
+            q2 = apply_rope_offsets(q2, positions, self.rope_base)
+            k2 = apply_rope_offsets(k2, positions, self.rope_base)
+        out, k_pages, v_pages = paged_attention(
+            q2, k2, v2, k_pages, v_pages, block_table, positions, valid
+        )
+        out, _ = self.proj.apply({"params": params["proj"], "state": {}}, out)
+        return out, k_pages, v_pages
 
     def __repr__(self):
         kv = (

@@ -1,0 +1,132 @@
+"""Paged KV-cache attention — gather/scatter over a shared block pool.
+
+The serving engine (``rocket_tpu.serve``) keeps every sequence's KV cache
+in a FIXED pool of HBM blocks instead of a per-call ``(B, T_max)`` dense
+cache: ``k_pages``/``v_pages`` are ``(num_blocks, block_len, Hkv, D)``
+arrays shared by every live request, and a per-slot ``block_table`` maps a
+sequence's logical positions onto pool blocks (vLLM's PagedAttention
+layout, arXiv 2309.06180). Thousands of concurrent sequences then share
+``num_blocks * block_bytes`` of HBM regardless of how many are admitted —
+the pool is allocated once and only the tables change.
+
+This module is the device-side math, written as plain XLA gather/scatter
+so it runs (and is tested) on any backend:
+
+* :func:`write_kv_pages` scatters a chunk's new K/V rows into the pool at
+  ``block_table[pos // block_len] * block_len + pos % block_len``. Rows
+  masked out by ``valid`` (prompt padding, inactive slots) are routed to
+  the RESERVED trash block 0, which the allocator never hands out — the
+  compiled step thus has one fixed shape for every admission state.
+* :func:`paged_attention` writes first, then gathers each slot's mapped
+  blocks back to a contiguous ``(S, T, Hkv, D)`` context and runs
+  causally-masked GQA attention with f32 softmax statistics over it, in
+  the feature-major layout (no head transposes — same reasoning as
+  ``ops/flash_native.py``).
+
+Layout notes for TPU: D stays the minor (lane) dimension end-to-end and
+``block_len`` should be a multiple of 8 (sublane tile) — the pool then
+tiles like the dense ``(B, Hkv, T, D)`` cache does. The gather
+materializes a transient ``(S, T, Hkv, D)`` context per wave (bounded by
+``max_slots * max_blocks_per_seq * block_len``); a pallas kernel that
+streams blocks VMEM-resident like ``ops/decode_attention.py`` is the
+known follow-up and slots in behind this exact signature.
+
+Inference only (no custom VJP — serving never differentiates).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["write_kv_pages", "paged_attention", "paged_gather"]
+
+
+def write_kv_pages(k_pages, v_pages, block_table, positions, valid, k_new, v_new):
+    """Scatter one chunk's K/V rows into the paged pool.
+
+    ``k_pages``/``v_pages`` ``(NB, BL, Hkv, D)``; ``block_table`` ``(S, MB)``
+    int32 block ids (0 = the reserved trash block); ``positions`` ``(S,)``
+    int32 — slot ``s``'s chunk occupies global positions
+    ``[positions[s], positions[s] + C)``; ``valid`` ``(S,)`` int32 — only the
+    first ``valid[s]`` rows of the chunk are real (the rest are padding and
+    land in the trash block); ``k_new``/``v_new`` ``(S, C, Hkv, D)``.
+    Returns the updated ``(k_pages, v_pages)``.
+    """
+    nb, bl = k_pages.shape[0], k_pages.shape[1]
+    s, c = k_new.shape[0], k_new.shape[1]
+    pos = positions[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]  # (S, C)
+    slot = jnp.clip(pos // bl, 0, block_table.shape[1] - 1)
+    block = jnp.take_along_axis(block_table, slot, axis=1)              # (S, C)
+    ok = jnp.arange(c, dtype=jnp.int32)[None, :] < valid[:, None]
+    # Flattened (block, row) target; masked rows collapse onto trash row 0
+    # (block 0 is never allocated, so collisions there are harmless).
+    flat = jnp.where(ok, block * bl + pos % bl, 0)                      # (S, C)
+    kf = k_pages.reshape((nb * bl,) + k_pages.shape[2:])
+    vf = v_pages.reshape((nb * bl,) + v_pages.shape[2:])
+    kf = kf.at[flat.reshape(-1)].set(
+        k_new.astype(kf.dtype).reshape((s * c,) + k_new.shape[2:])
+    )
+    vf = vf.at[flat.reshape(-1)].set(
+        v_new.astype(vf.dtype).reshape((s * c,) + v_new.shape[2:])
+    )
+    return kf.reshape(k_pages.shape), vf.reshape(v_pages.shape)
+
+
+def paged_gather(pages, block_table):
+    """Gather a slot batch's mapped blocks to a contiguous context:
+    ``(NB, BL, Hkv, D)`` pages + ``(S, MB)`` table -> ``(S, MB*BL, Hkv, D)``.
+    Row ``t`` of the result is the slot's global position ``t`` (table slot
+    ``j`` covers positions ``[j*BL, (j+1)*BL)``); unmapped entries gather
+    the trash block and must be masked off by position."""
+    s, mb = block_table.shape
+    bl = pages.shape[1]
+    ctx = jnp.take(pages, block_table, axis=0)          # (S, MB, BL, Hkv, D)
+    return ctx.reshape((s, mb * bl) + pages.shape[2:])
+
+
+def paged_attention(q, k_new, v_new, k_pages, v_pages, block_table,
+                    positions, valid):
+    """One chunk of causal GQA attention against the paged pool.
+
+    ``q`` ``(S, C, Hq, D)``; ``k_new``/``v_new`` ``(S, C, Hkv, D)`` (RoPE
+    already applied); pool/table/positions/valid as in
+    :func:`write_kv_pages`. The chunk's rows are written into the pool
+    FIRST, then each query row ``i`` attends over the gathered context at
+    key positions ``<= positions[s] + i`` — exact prefix semantics at any
+    chunk size (C=1 decode and C=chunk prefill share this one code path,
+    which is what makes chunked prefill bit-match one-shot prefill).
+
+    Returns ``(out (S, C, Hq*D), k_pages', v_pages')``. Padded query rows
+    (``i >= valid[s]``) produce well-defined garbage (position 0 is always
+    visible, so the softmax never sees an all-masked row) — callers ignore
+    them.
+    """
+    s, c, hq, d = q.shape
+    h_kv = k_pages.shape[2]
+    if hq % h_kv:
+        raise ValueError(f"paged_attention: Hq {hq} not a multiple of Hkv {h_kv}")
+    g = hq // h_kv
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, block_table, positions, valid, k_new, v_new
+    )
+    k_ctx = paged_gather(k_pages, block_table)          # (S, T, Hkv, D)
+    v_ctx = paged_gather(v_pages, block_table)
+    t = k_ctx.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    q5 = q.reshape(s, c, h_kv, g, d)
+    logits = jnp.einsum(
+        "sckgd,stkd->skgct", q5, k_ctx, preferred_element_type=jnp.float32
+    ) * scale                                           # (S, Hkv, G, C, T)
+    # Query at global position positions[s]+i sees key positions <= it.
+    key_pos = jnp.arange(t, dtype=jnp.int32)
+    q_pos = positions[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]  # (S, C, T)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "skgct,stkd->sckgd", weights.astype(v_ctx.dtype), v_ctx
+    ).reshape(s, c, hq * d)
+    return out, k_pages, v_pages

@@ -1,0 +1,36 @@
+"""rocket_tpu.serve — continuous-batching inference with a paged KV cache.
+
+The production decode path (ROADMAP item 1): ``generate()`` is a training
+adjunct — batch-static, its KV cache allocated per call — while this
+package turns the same decode machinery into a serving engine:
+
+* ``kv_pool`` — a fixed pool of HBM KV blocks shared by every live
+  request plus the host-side block allocator (peak pool HBM is
+  ``num_blocks * block_bytes`` no matter how many requests flow through);
+* ``engine`` — the compiled fixed-shape step family: ONE decode wave over
+  ``max_slots`` slots and ONE chunked-prefill step, per-slot sampling
+  params as runtime arrays, so admission/eviction never retraces;
+* ``scheduler`` — host-side continuous batching: finished slots are freed
+  and refilled every wave, prefill is chunked and interleaved with decode
+  waves, block exhaustion evicts the youngest request (back-pressure,
+  never OOM);
+* ``api`` — the :class:`ServeEngine` ``submit()``/``stream()`` facade with
+  streaming detokenization, obs wiring and the ``report()`` summary.
+
+``python -m rocket_tpu.serve`` serves a synthetic or stdin workload from a
+checkpoint. See ``docs/serving.md``.
+"""
+
+from rocket_tpu.serve.api import ServeConfig, ServeEngine, StreamDetokenizer
+from rocket_tpu.serve.kv_pool import BlockAllocator, KVPoolSpec
+from rocket_tpu.serve.scheduler import Request, Scheduler
+
+__all__ = [
+    "BlockAllocator",
+    "KVPoolSpec",
+    "Request",
+    "Scheduler",
+    "ServeConfig",
+    "ServeEngine",
+    "StreamDetokenizer",
+]

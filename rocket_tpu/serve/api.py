@@ -1,0 +1,366 @@
+"""ServeEngine — the user-facing serving facade.
+
+``submit()`` enqueues a request (token ids, or text when a tokenizer is
+attached), ``step()`` advances the engine one scheduling round,
+``stream()`` yields a request's output incrementally (detokenized when
+possible), ``report()`` summarizes latency/throughput percentiles, and
+the obs wiring publishes slot/pool/queue gauges plus per-request spans
+into an attached :class:`~rocket_tpu.obs.telemetry.Telemetry` so a serve
+run's ``telemetry.json`` carries the full serving story.
+
+Sizing defaults: the pool holds ``max_slots`` full-length sequences plus
+the reserved trash block — no oversubscription, so the engine never
+preempts unless you shrink ``num_blocks`` deliberately (the knob that
+turns on back-pressure testing). ``docs/serving.md`` walks the math.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional, Union
+
+import numpy as np
+
+from rocket_tpu.serve.engine import SlotEngine
+from rocket_tpu.serve.kv_pool import BlockAllocator, KVPoolSpec
+from rocket_tpu.serve.scheduler import Request, Scheduler, TickEvent
+
+__all__ = ["ServeConfig", "ServeEngine", "StreamDetokenizer"]
+
+
+@dataclass
+class ServeConfig:
+    """Engine sizing. ``None`` fields derive from the model config."""
+
+    max_slots: int = 8
+    block_len: int = 16
+    #: Pool blocks INCLUDING the reserved trash block 0. Default: enough
+    #: for every slot at full context (no oversubscription); set smaller
+    #: to exercise back-pressure/eviction.
+    num_blocks: Optional[int] = None
+    #: Longest context (prompt + generation) a single request may use.
+    #: Default: the model's max_seq_len.
+    max_model_len: Optional[int] = None
+    prefill_chunk: int = 16
+    #: Pool dtype. Default: the model's activation dtype (or f32).
+    dtype: Optional[str] = None
+    #: Completed Request records retained for ``result()``/``stream()``
+    #: readers; beyond this the OLDEST finished requests are dropped so a
+    #: long-running server's host memory stays bounded (``release()``
+    #: drops one eagerly).
+    max_completed_requests: int = 4096
+
+
+class StreamDetokenizer:
+    """Incremental detokenization for one stream: feed token ids, get the
+    NEW text suffix. Re-decodes the running token list each push (decoders
+    may merge across token boundaries — byte-level BPE), which is O(n) per
+    token on host strings; bounded by per-request generation lengths."""
+
+    def __init__(self, tokenizer) -> None:
+        self._tokenizer = tokenizer
+        self._tokens: list[int] = []
+        self._emitted = 0
+
+    def push(self, token: int) -> str:
+        self._tokens.append(int(token))
+        text = self._tokenizer.decode(self._tokens)
+        out = text[self._emitted:]
+        self._emitted = len(text)
+        return out
+
+
+def _percentiles(values: list, qs=(0.5, 0.9, 0.99)) -> Optional[dict]:
+    if not values:
+        return None
+    arr = np.sort(np.asarray(values, np.float64))
+    out = {f"p{int(q * 100)}": float(np.quantile(arr, q)) for q in qs}
+    out["mean"] = float(arr.mean())
+    out["count"] = int(arr.size)
+    return out
+
+
+class ServeEngine:
+    """Continuous-batching serving over one model + param tree.
+
+    ``telemetry``: an enabled :class:`~rocket_tpu.obs.telemetry.Telemetry`
+    gets serve gauges/histograms in its registry and one span per
+    completed request in its trace (category ``serve``); None keeps the
+    engine obs-free. The engine never owns/flushes the telemetry — the
+    caller (CLI, Runtime) decides when files are written.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        config: Optional[ServeConfig] = None,
+        *,
+        tokenizer=None,
+        telemetry=None,
+        key=None,
+    ) -> None:
+        cfg = config or ServeConfig()
+        mc = model.config
+        h_kv = mc.num_kv_heads or mc.num_heads
+        max_len = cfg.max_model_len or mc.max_seq_len
+        if max_len > mc.max_seq_len:
+            raise ValueError(
+                f"ServeConfig.max_model_len {max_len} exceeds the model's "
+                f"max_seq_len {mc.max_seq_len}"
+            )
+        mb = -(-max_len // cfg.block_len)  # ceil: blocks per sequence
+        num_blocks = cfg.num_blocks or (1 + cfg.max_slots * mb)
+        spec = KVPoolSpec(
+            num_layers=mc.num_layers,
+            num_blocks=num_blocks,
+            block_len=cfg.block_len,
+            num_kv_heads=h_kv,
+            head_dim=mc.dim // mc.num_heads,
+            dtype=cfg.dtype or mc.activation_dtype or "float32",
+        )
+        self.config = cfg
+        self.engine = SlotEngine(
+            model, params, spec,
+            max_slots=cfg.max_slots,
+            max_blocks_per_seq=mb,
+            prefill_chunk=cfg.prefill_chunk,
+            key=key,
+        )
+        self.scheduler = Scheduler(self.engine, BlockAllocator(num_blocks))
+        self.tokenizer = tokenizer
+        self.telemetry = telemetry
+        self.requests: dict[int, Request] = {}
+        self._finished_order: list[int] = []  # completion-ordered rids
+        # Latency records (seconds), trimmed to a bounded tail so week-long
+        # servers don't grow host memory with per-token floats.
+        self._ttft: list[float] = []
+        self._itl: list[float] = []
+        self._latency_cap = 200_000
+        self._last_emit: dict[int, float] = {}  # rid -> last emit time
+        self._first_wave_at: Optional[float] = None
+        self._last_event_at: Optional[float] = None
+        self._occupancy_sum = 0
+        self._ticks = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Union[str, np.ndarray, list],
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+    ) -> int:
+        """Enqueue one request; returns its id. ``prompt`` may be text
+        when a tokenizer is attached."""
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "ServeEngine.submit: text prompt needs a tokenizer"
+                )
+            prompt = self.tokenizer.encode(prompt)
+        req = Request(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos_token_id,
+        )
+        rid = self.scheduler.submit(req)
+        self.requests[rid] = req
+        return rid
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> list[TickEvent]:
+        """One scheduling round; records latency metrics and publishes the
+        obs gauges."""
+        events = self.scheduler.tick()
+        self._ticks += 1
+        self._occupancy_sum += self.scheduler.active_slots
+        now = time.perf_counter()
+        if events:
+            if self._first_wave_at is None:
+                self._first_wave_at = now
+            self._last_event_at = now
+        for ev in events:
+            req = ev.request
+            prev = self._last_emit.get(req.id)
+            if prev is None:
+                self._ttft.append(req.first_token_at - req.submitted_at)
+            else:
+                # Inter-token latency: the wave cadence this request saw.
+                self._itl.append(now - prev)
+            if ev.finished:
+                self._last_emit.pop(req.id, None)
+                self._finish_span(req)
+                self._retire(req.id)
+            else:
+                self._last_emit[req.id] = now
+        del self._ttft[:-self._latency_cap]
+        del self._itl[:-self._latency_cap]
+        self._publish()
+        return events
+
+    def _retire(self, rid: int) -> None:
+        """Bound the completed-request record: keep the newest
+        ``max_completed_requests`` finished Requests readable, drop the
+        oldest beyond that."""
+        self._finished_order.append(rid)
+        cap = max(self.config.max_completed_requests, 0)
+        while len(self._finished_order) > cap:
+            old = self._finished_order.pop(0)
+            self.requests.pop(old, None)
+
+    def release(self, rid: int) -> None:
+        """Drop a finished request's record eagerly (long-running servers
+        that consume results as they stream need no retention at all)."""
+        req = self.requests.get(rid)
+        if req is not None and not req.finished:
+            raise ValueError(f"ServeEngine.release: request {rid} still live")
+        self.requests.pop(rid, None)
+        try:
+            self._finished_order.remove(rid)
+        except ValueError:
+            pass
+
+    def drain(self, max_ticks: int = 100_000) -> list[TickEvent]:
+        """Step until every submitted request completed."""
+        events = []
+        for _ in range(max_ticks):
+            if self.scheduler.idle:
+                return events
+            events.extend(self.step())
+        raise RuntimeError(f"ServeEngine.drain: not idle after {max_ticks} ticks")
+
+    def stream(self, rid: int, max_ticks: int = 100_000) -> Iterator:
+        """Yield request ``rid``'s output incrementally — text pieces with
+        a tokenizer, raw token ids without — stepping the engine while the
+        request is live. Interleaves fine with other requests: tokens for
+        everyone else keep landing on their Request records."""
+        req = self.requests[rid]
+        detok = (
+            StreamDetokenizer(self.tokenizer)
+            if self.tokenizer is not None else None
+        )
+        emitted = 0
+        for _ in range(max_ticks):
+            while emitted < len(req.tokens):
+                tok = req.tokens[emitted]
+                emitted += 1
+                yield detok.push(tok) if detok is not None else tok
+            if req.finished:
+                return
+            if self.scheduler.idle:
+                raise RuntimeError(
+                    f"ServeEngine.stream: engine idle but request {rid} "
+                    "unfinished"
+                )
+            self.step()
+        raise RuntimeError(f"ServeEngine.stream: no finish in {max_ticks} ticks")
+
+    def result(self, rid: int) -> Request:
+        return self.requests[rid]
+
+    def text(self, rid: int) -> str:
+        if self.tokenizer is None:
+            raise ValueError("ServeEngine.text: no tokenizer attached")
+        return self.tokenizer.decode(self.requests[rid].tokens)
+
+    # -- observability -----------------------------------------------------
+
+    def _finish_span(self, req: Request) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.spans.add(
+            f"serve/request[{req.id}]", "serve",
+            req.submitted_at, req.finished_at - req.submitted_at,
+        )
+        tel.registry.histogram("serve/ttft_s", base=1e-4).observe(
+            req.first_token_at - req.submitted_at
+        )
+
+    def _publish(self) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        reg = tel.registry
+        sched = self.scheduler
+        reg.gauge("serve/slots_active").set(sched.active_slots)
+        reg.gauge("serve/queue_depth").set(sched.queue_depth)
+        reg.gauge("serve/blocks_free_fraction").set(
+            sched.allocator.free_fraction
+        )
+        reg.gauge("serve/kv_pool_bytes").set(self.engine.spec.pool_bytes)
+        reg.gauge("serve/tokens_generated").set(sched.tokens_generated)
+        reg.gauge("serve/requests_completed").set(sched.completed)
+        reg.gauge("serve/preemptions").set(sched.preemptions)
+        # The compiled-once proof, surfaced where telemetry.json lands it.
+        reg.gauge("serve/decode_traces").set(self.engine.decode_traces)
+        reg.gauge("serve/prefill_traces").set(self.engine.prefill_traces)
+
+    def reset_metrics(self) -> None:
+        """Zero the latency/throughput aggregates — NOT the compile-trace
+        counters, which are the engine-lifetime no-retrace proof. Call
+        while idle (e.g. after a warmup ``drain()``): benchmarks warm the
+        compiled steps with a few requests, reset, then measure
+        steady-state serving without compile time in the percentiles."""
+        self._ttft.clear()
+        self._itl.clear()
+        self._first_wave_at = None
+        self._last_event_at = None
+        self._occupancy_sum = 0
+        self._ticks = 0
+        sched = self.scheduler
+        sched.submitted = sched.queue_depth + sched.active_slots
+        sched.completed = 0
+        sched.preemptions = 0
+        sched.tokens_generated = 0
+        sched.waves_idle = 0
+
+    def report(self) -> dict:
+        """Latency/throughput summary for this engine's lifetime."""
+        sched = self.scheduler
+        busy = None
+        if self._first_wave_at is not None and self._last_event_at is not None:
+            busy = max(self._last_event_at - self._first_wave_at, 1e-9)
+        return {
+            "requests": {
+                "submitted": sched.submitted,
+                "completed": sched.completed,
+                "queued": sched.queue_depth,
+                "preemptions": sched.preemptions,
+            },
+            "tokens_generated": sched.tokens_generated,
+            "tokens_per_sec": (
+                None if busy is None else sched.tokens_generated / busy
+            ),
+            "time_to_first_token_s": _percentiles(self._ttft),
+            "inter_token_latency_s": _percentiles(self._itl),
+            "compiled": {
+                "decode_traces": self.engine.decode_traces,
+                "prefill_traces": self.engine.prefill_traces,
+                "decode_waves": self.engine.decode_waves,
+                "prefill_chunks": self.engine.prefill_chunks,
+            },
+            "pool": {
+                "num_blocks": self.engine.spec.num_blocks,
+                "block_len": self.engine.spec.block_len,
+                "block_bytes": self.engine.spec.block_bytes,
+                "kv_pool_bytes": self.engine.spec.pool_bytes,
+                "free_fraction": sched.allocator.free_fraction,
+            },
+            "slots": {
+                "max_slots": self.engine.max_slots,
+                "occupancy_mean": (
+                    self._occupancy_sum / self._ticks if self._ticks else 0.0
+                ),
+            },
+        }

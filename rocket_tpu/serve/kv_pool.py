@@ -1,0 +1,139 @@
+"""Paged KV block pool — fixed-shape HBM arrays + the host-side allocator.
+
+The pool is the serving engine's only model-state memory: two
+``(L, num_blocks, block_len, Hkv, D)`` arrays allocated ONCE, sized
+independently of how many requests ever flow through the engine. Requests
+own *blocks*, not cache rows: the allocator hands out integer block ids on
+the host and the compiled step indexes the pool through per-slot block
+tables (``ops/paged_attention.py``), so admitting a request is a few host
+list operations and never touches compiled code.
+
+Block 0 is RESERVED as the trash sink: masked writes (prompt padding,
+inactive slots) land there and unmapped block-table entries point at it,
+which is what lets one fixed-shape compiled step serve every admission
+state. The allocator never hands it out.
+
+Fragmentation: blocks are the unit of allocation, so there is no external
+fragmentation by construction — any free block serves any request; the
+only waste is internal (the tail of a sequence's last block, bounded by
+``block_len - 1`` rows per sequence).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["KVPoolSpec", "BlockAllocator"]
+
+
+@dataclass(frozen=True)
+class KVPoolSpec:
+    """Shape of the paged pool for one model."""
+
+    num_layers: int
+    num_blocks: int
+    block_len: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError(
+                "KVPoolSpec: need at least 2 blocks (block 0 is the "
+                f"reserved trash sink), got {self.num_blocks}"
+            )
+        if self.block_len < 1:
+            raise ValueError(f"KVPoolSpec: block_len {self.block_len} < 1")
+
+    @property
+    def block_bytes(self) -> int:
+        """HBM bytes ONE block costs across K+V and all layers."""
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (
+            2 * self.num_layers * self.block_len * self.num_kv_heads
+            * self.head_dim * itemsize
+        )
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total pool HBM: ``num_blocks * block_bytes`` — the serving
+        engine's peak KV memory regardless of request count."""
+        return self.num_blocks * self.block_bytes
+
+    def init_pages(self):
+        """The zeroed device pool: ``(k_pages, v_pages)``, each
+        ``(L, NB, BL, Hkv, D)``."""
+        shape = (
+            self.num_layers, self.num_blocks, self.block_len,
+            self.num_kv_heads, self.head_dim,
+        )
+        dt = jnp.dtype(self.dtype)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+class BlockAllocator:
+    """Host-side free-list over block ids ``1 .. num_blocks-1``.
+
+    All-or-nothing ``alloc(n)`` (a partially admitted request would leak
+    on the failure path) and loud invariant checks: double-alloc,
+    double-free and freeing the reserved block are bugs, not conditions
+    to paper over.
+    """
+
+    RESERVED = 0  # the trash block — never allocated
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"BlockAllocator: need at least 2 blocks, got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids first
+        self._used: set[int] = set()
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the reserved trash block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    @property
+    def free_fraction(self) -> float:
+        return len(self._free) / max(self.capacity, 1)
+
+    def alloc(self, n: int = 1) -> Optional[list[int]]:
+        """``n`` block ids, or None when the pool can't serve all of them
+        (the caller applies back-pressure / eviction — this is the one
+        condition that is NOT an error)."""
+        if n < 0:
+            raise ValueError(f"BlockAllocator.alloc: n {n} < 0")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._used.update(blocks)
+        return blocks
+
+    def free(self, blocks) -> None:
+        for block in blocks:
+            if block == self.RESERVED:
+                raise ValueError(
+                    "BlockAllocator.free: block 0 is the reserved trash sink"
+                )
+            if block not in self._used:
+                raise ValueError(
+                    f"BlockAllocator.free: block {block} is not allocated "
+                    "(double free?)"
+                )
+            self._used.remove(block)
+            self._free.append(block)

@@ -1,0 +1,347 @@
+"""Continuous-batching request scheduler — the host-side policy half.
+
+Every ``tick()`` is one serving step:
+
+1. **admit** queued requests into free slots while the block pool can
+   cover their prompts (all-or-nothing — a request never half-admits);
+2. **prefill** one fixed-size chunk of the oldest still-prefilling slot
+   (chunked prefill: long prompts trickle in a chunk per tick and never
+   stall the decode latency of running requests);
+3. **grow** each decode-ready slot's block table to cover the next token;
+   when the pool is exhausted the YOUNGEST active request is evicted —
+   its blocks return to the pool and it re-queues at the FRONT with its
+   generated tokens folded into the prompt, so it resumes exactly where
+   it stopped after re-prefill (back-pressure, never OOM);
+4. run ONE **decode wave** over all decode-ready slots;
+5. **harvest**: emitted tokens stream out, finished slots free their
+   blocks and are refillable on the very next tick.
+
+The scheduler owns host-side numpy mirrors of every per-slot array the
+compiled wave consumes (block table, lengths, sampling vectors, masks).
+Admission/eviction mutate the mirrors only — shapes and dtypes are fixed
+at construction, which is what keeps the engine's compiled-once guarantee
+(asserted via the trace counters in ``serve/engine.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from rocket_tpu.serve.engine import SlotEngine
+from rocket_tpu.serve.kv_pool import BlockAllocator
+
+__all__ = ["Request", "TickEvent", "Scheduler"]
+
+
+@dataclass
+class Request:
+    """One generation request plus its lifecycle record."""
+
+    prompt: np.ndarray                       # (P,) int32, P >= 1
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: Optional[int] = None              # None/0 = off
+    top_p: Optional[float] = None            # None/1.0 = off
+    eos_token_id: Optional[int] = None       # None = no EOS
+    id: int = -1                             # assigned at submit()
+    # -- runtime record (scheduler-owned) ----------------------------------
+    tokens: list = field(default_factory=list)   # generated so far
+    preemptions: int = 0
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finished_at is not None
+
+
+@dataclass(frozen=True)
+class TickEvent:
+    """One emitted token (``finished`` marks the request's last)."""
+
+    request: Request
+    token: int
+    finished: bool
+
+
+class _Slot:
+    """Per-slot bookkeeping while a request occupies the wave."""
+
+    __slots__ = ("req", "blocks", "ctx", "prefill_pos", "admit_order")
+
+    def __init__(self, req: Request, blocks: list[int], ctx: np.ndarray,
+                 admit_order: int) -> None:
+        self.req = req
+        self.blocks = blocks
+        #: The context to (re-)prefill: original prompt + tokens generated
+        #: before a preemption — resuming re-fills the pool and continues.
+        self.ctx = ctx
+        self.prefill_pos = 0
+        self.admit_order = admit_order
+
+    @property
+    def prefill_done(self) -> bool:
+        # Prefill covers [0, P-1); the LAST context token goes through the
+        # decode wave itself (writes its KV row AND yields the next-token
+        # logits) — admission is uniform for P == 1 prompts.
+        return self.prefill_pos >= len(self.ctx) - 1
+
+
+class Scheduler:
+    def __init__(self, engine: SlotEngine, allocator: Optional[BlockAllocator] = None) -> None:
+        self.engine = engine
+        self.allocator = allocator or BlockAllocator(engine.spec.num_blocks)
+        s = engine.max_slots
+        mb = engine.max_blocks_per_seq
+        self.block_len = engine.spec.block_len
+        self.max_context = mb * self.block_len
+        # Host mirrors of the wave inputs — fixed shape + dtype forever.
+        self.block_table = np.zeros((s, mb), np.int32)
+        self.lengths = np.zeros((s,), np.int32)
+        self.last_tok = np.zeros((s,), np.int32)
+        self.limits = np.zeros((s,), np.int32)
+        self.temp = np.zeros((s,), np.float32)
+        self.top_k = np.zeros((s,), np.int32)
+        self.top_p = np.ones((s,), np.float32)
+        self.eos = np.full((s,), -1, np.int32)
+        self.seeds = np.zeros((s,), np.int32)
+        self.slots: list[Optional[_Slot]] = [None] * s
+        self.queue: deque[Request] = deque()
+        self._next_id = 0
+        self._admit_seq = 0
+        # Aggregates for the report / gauges.
+        self.submitted = 0
+        self.completed = 0
+        self.preemptions = 0
+        self.tokens_generated = 0
+        self.waves_idle = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, req: Request) -> int:
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("Scheduler.submit: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("Scheduler.submit: max_new_tokens must be >= 1")
+        if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
+            # Same guard as generate(): top_p <= 0 would mask EVERY token
+            # to -inf and the slot would silently stream token 0 forever.
+            raise ValueError(
+                f"Scheduler.submit: top_p must be in (0, 1], got {req.top_p}"
+            )
+        total = prompt.size + req.max_new_tokens
+        if total > self.max_context:
+            raise ValueError(
+                f"Scheduler.submit: prompt {prompt.size} + "
+                f"{req.max_new_tokens} new tokens exceed the per-slot "
+                f"context {self.max_context} (max_blocks_per_seq * block_len)"
+            )
+        max_len = self.engine.model.config.max_seq_len
+        if total > max_len:
+            raise ValueError(
+                f"Scheduler.submit: request needs {total} positions > "
+                f"model max_seq_len {max_len}"
+            )
+        need = -(-total // self.block_len)  # ceil
+        if need > self.allocator.capacity:
+            raise ValueError(
+                f"Scheduler.submit: request needs {need} blocks but the "
+                f"pool only has {self.allocator.capacity} — no eviction "
+                "policy can make room for it"
+            )
+        req.prompt = prompt
+        req.id = self._next_id
+        self._next_id += 1
+        req.submitted_at = time.perf_counter()
+        self.queue.append(req)
+        self.submitted += 1
+        return req.id
+
+    # -- the serving step --------------------------------------------------
+
+    def tick(self) -> list[TickEvent]:
+        """One scheduling round: admit / prefill one chunk / grow tables
+        (evicting on exhaustion) / one decode wave / harvest. Returns the
+        tokens emitted this round; an idle engine returns []."""
+        self._admit()
+        self._prefill_one()
+        run = self._grow_tables()
+        if not run.any():
+            self.waves_idle += 1
+            return []
+        salts = (
+            (self.seeds.astype(np.int64) * 1000003 + self.lengths)
+            % np.int64(2**31)
+        ).astype(np.int32)
+        nxt, done = self.engine.decode(
+            self.block_table, self.lengths, self.last_tok, run, self.limits,
+            self.temp, self.top_k, self.top_p, self.eos, salts,
+        )
+        return self._harvest(run, nxt, done)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> list[TickEvent]:
+        events = []
+        for _ in range(max_ticks):
+            if self.idle:
+                return events
+            events.extend(self.tick())
+        raise RuntimeError(
+            f"Scheduler.run_until_idle: not idle after {max_ticks} ticks"
+        )
+
+    # -- phases ------------------------------------------------------------
+
+    def _admit(self) -> None:
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        while self.queue and free:
+            req = self.queue[0]
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)]
+            ) if req.tokens else req.prompt
+            need = -(-len(ctx) // self.block_len)
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                return  # back-pressure: wait for running requests to free
+            self.queue.popleft()
+            slot = free.pop(0)
+            st = _Slot(req, blocks, ctx, self._admit_seq)
+            self._admit_seq += 1
+            self.slots[slot] = st
+            self.block_table[slot] = 0
+            self.block_table[slot, :need] = blocks
+            self.lengths[slot] = 0
+            self.last_tok[slot] = ctx[-1]
+            # Absolute row limit in ORIGINAL-prompt terms: rows written
+            # when the g-th generated token lands = (P-1) + g.
+            self.limits[slot] = len(req.prompt) - 1 + req.max_new_tokens
+            self.temp[slot] = req.temperature
+            self.top_k[slot] = req.top_k or 0
+            self.top_p[slot] = 1.0 if req.top_p is None else req.top_p
+            self.eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
+            self.seeds[slot] = req.id % (2**31 - 1)
+
+    def _prefill_one(self) -> None:
+        """One chunk for the OLDEST still-prefilling slot (FIFO keeps TTFT
+        fair); the chunk is fixed-shape, tail-padded and masked."""
+        pending = [
+            (st.admit_order, i) for i, st in enumerate(self.slots)
+            if st is not None and not st.prefill_done
+        ]
+        if not pending:
+            return
+        _, slot = min(pending)
+        st = self.slots[slot]
+        c = self.engine.prefill_chunk
+        start = st.prefill_pos
+        chunk = st.ctx[start:min(start + c, len(st.ctx) - 1)]
+        valid = len(chunk)
+        if valid < c:
+            chunk = np.pad(chunk, (0, c - valid))
+        self.engine.prefill(
+            self.block_table[slot:slot + 1],
+            chunk[None, :].astype(np.int32),
+            np.asarray([start], np.int32),
+            np.asarray([valid], np.int32),
+        )
+        st.prefill_pos = start + valid
+        self.lengths[slot] = st.prefill_pos
+
+    def _grow_tables(self) -> np.ndarray:
+        """Cover position ``lengths[s]`` for every decode-ready slot,
+        evicting the youngest active request on pool exhaustion. Returns
+        the wave's run mask."""
+        run = np.zeros((self.engine.max_slots,), bool)
+        for slot, st in enumerate(self.slots):
+            if st is None or not st.prefill_done:
+                continue
+            need_idx = int(self.lengths[slot]) // self.block_len
+            while need_idx >= len(st.blocks):
+                got = self.allocator.alloc(1)
+                if got is None:
+                    victim = self._youngest_active()
+                    self._evict(victim)
+                    # The victim may already have been approved earlier in
+                    # this sweep — it no longer runs this wave.
+                    run[victim] = False
+                    if victim == slot:
+                        break
+                    continue
+                self.block_table[slot, len(st.blocks)] = got[0]
+                st.blocks.extend(got)
+            if self.slots[slot] is st:  # not evicted above
+                run[slot] = True
+        return run
+
+    def _youngest_active(self) -> int:
+        candidates = [
+            (st.admit_order, i) for i, st in enumerate(self.slots)
+            if st is not None
+        ]
+        return max(candidates)[1]
+
+    def _evict(self, slot: int) -> None:
+        """Preempt: blocks back to the pool, request to the FRONT of the
+        queue with its progress folded into the context — it resumes (not
+        restarts) once blocks free up."""
+        st = self.slots[slot]
+        self.allocator.free(st.blocks)
+        st.req.preemptions += 1
+        self.preemptions += 1
+        self.queue.appendleft(st.req)
+        self._clear(slot)
+
+    def _harvest(self, run: np.ndarray, nxt, done) -> list[TickEvent]:
+        now = time.perf_counter()
+        events = []
+        for slot in np.nonzero(run)[0]:
+            st = self.slots[int(slot)]
+            tok = int(nxt[slot])
+            st.req.tokens.append(tok)
+            if st.req.first_token_at is None:
+                st.req.first_token_at = now
+            st.req.last_token_at = now
+            self.tokens_generated += 1
+            self.lengths[slot] += 1
+            self.last_tok[slot] = tok
+            finished = bool(done[slot])
+            if finished:
+                st.req.finished_at = now
+                self.completed += 1
+                self.allocator.free(st.blocks)
+                self._clear(int(slot))
+            events.append(TickEvent(st.req, tok, finished))
+        return events
+
+    def _clear(self, slot: int) -> None:
+        self.slots[slot] = None
+        self.block_table[slot] = 0
+        self.lengths[slot] = 0
+        self.last_tok[slot] = 0
+        self.limits[slot] = 0
+        self.temp[slot] = 0.0
+        self.top_k[slot] = 0
+        self.top_p[slot] = 1.0
+        self.eos[slot] = -1
+        self.seeds[slot] = 0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)

@@ -53,6 +53,14 @@ echo "== blackbox smoke (injected NaN -> skip_step / forensic bundle) =="
 # complete runs/**/blackbox/ bundle the post-mortem CLI renders.
 JAX_PLATFORMS=cpu python scripts/blackbox_smoke.py
 
+echo "== serve smoke (continuous batching + paged KV + compiled-once) =="
+# A 50-request synthetic workload through rocket_tpu.serve plus the
+# python -m rocket_tpu.serve CLI: every request must complete, the decode
+# wave / prefill chunk must each compile exactly ONCE (zero retraces
+# across admissions/evictions — checked against the obs gauges in
+# telemetry.json), and greedy outputs must match generate().
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

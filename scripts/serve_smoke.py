@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Serve smoke gate: the continuous-batching engine end to end on CPU.
+
+Two legs (wired into scripts/check.sh and CI):
+
+1. **In-process**: a 50-request synthetic workload on a tiny LM through
+   :class:`rocket_tpu.serve.ServeEngine` must (a) complete every request,
+   (b) compile the decode wave and the prefill chunk exactly ONCE — zero
+   retraces across 50 admissions/evictions/refills, checked against the
+   obs registry gauges, (c) produce greedy outputs token-identical to
+   ``generate()`` for sampled spot-checks, and (d) leave a telemetry.json
+   whose serve gauges + per-request spans tell the same story.
+2. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess must stream
+   output, print the serve report, exit 0, and the ``report`` subcommand
+   must render its telemetry.
+
+Exits non-zero on the first violated invariant.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def check(condition, message):
+    if not condition:
+        print(f"serve smoke FAILED: {message}", file=sys.stderr)
+        sys.exit(1)
+
+
+def engine_leg(out_dir: str) -> None:
+    from rocket_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        generate,
+    )
+    from rocket_tpu.obs.telemetry import Telemetry
+    from rocket_tpu.serve import ServeConfig, ServeEngine
+
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(0))
+
+    telemetry = Telemetry(enabled=True, out_dir=out_dir)
+    telemetry.start()
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=48, num_blocks=17),  # starved -> evictions
+        telemetry=telemetry,
+    )
+    rng = np.random.default_rng(0)
+    jobs = []
+    for _ in range(50):
+        plen = int(rng.integers(1, 14))
+        maxnew = int(rng.integers(1, 10))
+        prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+        rid = engine.submit(prompt, max_new_tokens=maxnew, temperature=0.0)
+        jobs.append((rid, prompt, maxnew))
+    engine.drain()
+    report = engine.report()
+    check(report["requests"]["completed"] == 50,
+          f"completed {report['requests']}")
+    check(report["compiled"]["decode_traces"] == 1,
+          f"decode retraced: {report['compiled']}")
+    check(report["compiled"]["prefill_traces"] == 1,
+          f"prefill retraced: {report['compiled']}")
+    check(report["tokens_per_sec"] and report["tokens_per_sec"] > 0,
+          f"tokens_per_sec {report['tokens_per_sec']}")
+    check(report["time_to_first_token_s"]["count"] == 50, "ttft count")
+
+    # Greedy spot-checks against generate() (every 10th request).
+    for rid, prompt, maxnew in jobs[::10]:
+        ref = np.asarray(
+            generate(model, variables, prompt[None, :], maxnew, temperature=0)
+        )[0, len(prompt):]
+        got = np.asarray(engine.result(rid).tokens, np.int32)
+        check((got == ref).all(), f"request {rid}: {got} != {ref}")
+
+    telemetry.flush()
+    telemetry.close(write=False)
+
+    tel_path = os.path.join(out_dir, "telemetry.json")
+    check(os.path.exists(tel_path), f"{tel_path} missing")
+    with open(tel_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    gauges = doc["metrics"]["gauges"]
+    for name, want in [
+        ("serve/decode_traces", 1), ("serve/prefill_traces", 1),
+        ("serve/requests_completed", 50),
+    ]:
+        check(gauges.get(name) == want,
+              f"telemetry gauge {name} = {gauges.get(name)}, want {want}")
+    check(gauges.get("serve/tokens_generated", 0) > 0, "no tokens gauge")
+    check(gauges.get("serve/kv_pool_bytes") == engine.engine.spec.pool_bytes,
+          "kv_pool_bytes gauge")
+    with open(os.path.join(out_dir, "spans.trace.json"), encoding="utf-8") as f:
+        spans = json.load(f)["traceEvents"]
+    n_req_spans = sum(
+        1 for e in spans if str(e.get("name", "")).startswith("serve/request[")
+    )
+    check(n_req_spans == 50, f"{n_req_spans} request spans, want 50")
+    print(f"serve smoke: engine leg OK "
+          f"(preemptions={report['requests']['preemptions']}, "
+          f"tok/s={report['tokens_per_sec']:.0f})")
+
+
+def cli_leg(out_dir: str) -> None:
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.serve", "--requests", "12",
+         "--max-new-tokens", "8", "--max-slots", "4", "--block-len", "8",
+         "--prefill-chunk", "8", "--show", "1", "--out-dir", out_dir],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    check(proc.returncode == 0,
+          f"CLI exited {proc.returncode}:\n{proc.stdout}\n{proc.stderr}")
+    check("--- request 0 ---" in proc.stdout, "no streamed output")
+    check("serve_report" in proc.stdout, "no report on stdout")
+    payload = json.loads(proc.stdout[proc.stdout.index("{"):])
+    check(payload["serve_report"]["requests"]["completed"] == 12,
+          "CLI report completion count")
+    check(os.path.exists(os.path.join(out_dir, "telemetry.json")),
+          "CLI telemetry.json missing")
+
+    rep = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.serve", "report", out_dir],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    check(rep.returncode == 0, f"report subcommand failed:\n{rep.stderr}")
+    check("serve/decode_traces" in rep.stdout, "report missing trace gauge")
+    print("serve smoke: CLI leg OK")
+
+
+def main() -> None:
+    repo_runs = os.path.join(REPO, "runs")
+    os.makedirs(repo_runs, exist_ok=True)
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_", dir=repo_runs)
+    engine_leg(os.path.join(workdir, "engine"))
+    cli_leg(os.path.join(workdir, "cli"))
+    print("serve smoke: all checks passed")
+
+
+if __name__ == "__main__":
+    main()

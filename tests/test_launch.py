@@ -8,6 +8,11 @@ import sys
 
 @pytest.mark.slow
 def test_launch_two_processes(tmp_path):
+    # Same backend gate as the test_multiprocess spawn tests: skip when
+    # this jax build's CPU backend can't run cross-process collectives.
+    from tests.test_multiprocess import _require_multiprocess_backend
+
+    _require_multiprocess_backend()
     script = tmp_path / "worker.py"
     script.write_text(
         "import jax\n"

@@ -16,6 +16,80 @@ import socket
 import subprocess
 import sys
 
+# ---------------------------------------------------------------------------
+# Backend capability probe (PR 5 note / ISSUE 7 satellite): some CPU-only
+# containers ship a jax whose CPU backend cannot run cross-process
+# collectives — the 2-proc spawn tests then fail at HEAD through no fault of
+# the code under test. Probe once per session with a minimal 2-process
+# psum; skip (not fail) the spawn tests when the backend can't do it. The
+# probe only ever runs under --runslow (these tests are slow-marked), so
+# the tier-1 fast tier stays deterministic and probe-free.
+# ---------------------------------------------------------------------------
+
+_PROBE = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+    num_processes=2,
+    process_id=int(os.environ["JAX_PROCESS_ID"]),
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+total = multihost_utils.process_allgather(
+    jnp.ones(()) * (1 + jax.process_index())
+).sum()
+assert int(total) == 3, total
+print("PROBE_OK", flush=True)
+"""
+
+_probe_result = {}
+
+
+def _multiprocess_backend_ok() -> bool:
+    """True when this jax build can run 2-process CPU collectives
+    (memoized: one probe per test session)."""
+    if "ok" not in _probe_result:
+        port = _free_port()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.update(
+                JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                JAX_NUM_PROCESSES="2",
+                JAX_PROCESS_ID=str(rank),
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            )
+            env.pop("JAX_PLATFORM_NAME", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _PROBE], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        ok = True
+        for proc in procs:
+            try:
+                out, _ = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                    p.communicate()
+                ok = False
+                break
+            ok = ok and proc.returncode == 0 and "PROBE_OK" in out
+        _probe_result["ok"] = ok
+    return _probe_result["ok"]
+
+
+def _require_multiprocess_backend():
+    if not _multiprocess_backend_ok():
+        pytest.skip(
+            "backend capability probe: this jax build's CPU backend cannot "
+            "run cross-process collectives in this container"
+        )
+
+
 _WORKER = r"""
 import os, sys
 import jax
@@ -191,6 +265,7 @@ def _free_port() -> int:
 
 @pytest.mark.slow
 def test_two_process_distributed_train_and_checkpoint(tmp_path):
+    _require_multiprocess_backend()
     port = _free_port()
     procs = []
     for rank in range(2):
@@ -394,6 +469,7 @@ def test_elastic_checkpoint_restore_across_process_counts(tmp_path):
     under 4. The resharding reader must rebuild identical state from the
     2-host shard files on every topology, and the 4-process leg doubles
     as the >2-process smoke test."""
+    _require_multiprocess_backend()
     import numpy as np
 
     outs = _spawn_group(2, 2, _ELASTIC_SAVER, tmp_path, distributed=True)

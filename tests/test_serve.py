@@ -1,0 +1,437 @@
+"""rocket_tpu.serve — paged KV pool, compiled-once engine, continuous batching.
+
+The load-bearing assertions:
+
+* block-pool alloc/free invariants (no double alloc/free, reserved trash
+  block, all-or-nothing allocation, zero external fragmentation);
+* chunked prefill == one-shot prefill logits (same compiled code path at
+  any chunk size);
+* admitting/evicting/refilling requests across a 50-request workload
+  causes ZERO decode-step retraces (trace counters + the obs registry
+  gauge) — the compiled-once guarantee of ISSUE 7;
+* EOS, per-slot sampling params, eviction under a starved pool, and the
+  e2e outputs matching ``generate()`` greedy token-for-token.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.models.transformer import TransformerConfig, TransformerLM, generate
+from rocket_tpu.serve import (
+    BlockAllocator,
+    KVPoolSpec,
+    ServeConfig,
+    ServeEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(0))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def llama_lm():
+    """RoPE + RMSNorm + GQA + untied head — the other cache geometry."""
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, pos_embedding="rope", norm="rmsnorm", mlp="swiglu",
+        tied_embeddings=False, dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(1))
+    return model, variables
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+# ---------------------------------------------------------------------------
+
+def test_block_allocator_invariants():
+    alloc = BlockAllocator(8)  # blocks 1..7 allocatable, 0 reserved
+    assert alloc.capacity == 7
+    a = alloc.alloc(3)
+    b = alloc.alloc(4)
+    assert sorted(a + b) == list(range(1, 8))  # block 0 never handed out
+    assert alloc.alloc(1) is None              # exhausted -> None, not raise
+    assert alloc.num_free == 0 and alloc.free_fraction == 0.0
+    alloc.free(a)
+    assert alloc.num_free == 3 and alloc.free_fraction == pytest.approx(3 / 7)
+    # All-or-nothing: asking for more than free allocates NOTHING.
+    assert alloc.alloc(4) is None
+    assert alloc.num_free == 3
+    # Any free block serves any request — no external fragmentation: the
+    # freed ids are immediately reusable regardless of original grouping.
+    c = alloc.alloc(3)
+    assert sorted(c) == sorted(a)
+    with pytest.raises(ValueError):
+        alloc.free([c[0], c[0]])  # double free
+    with pytest.raises(ValueError):
+        alloc.free([0])           # reserved trash block
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+
+
+def test_kv_pool_spec_bytes_and_pages():
+    spec = KVPoolSpec(num_layers=2, num_blocks=5, block_len=4,
+                      num_kv_heads=3, head_dim=8, dtype="bfloat16")
+    assert spec.block_bytes == 2 * 2 * 4 * 3 * 8 * 2
+    assert spec.pool_bytes == 5 * spec.block_bytes
+    k, v = spec.init_pages()
+    assert k.shape == v.shape == (2, 5, 4, 3, 8)
+    assert k.dtype == jnp.bfloat16
+    with pytest.raises(ValueError):
+        KVPoolSpec(num_layers=1, num_blocks=1, block_len=4,
+                   num_kv_heads=1, head_dim=8)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode correctness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lm", ["tiny_lm", "llama_lm"])
+@pytest.mark.parametrize("chunk", [3, 16])
+def test_chunked_prefill_matches_one_shot_logits(lm, chunk, request):
+    """Prefill through the paged path in chunks of any size must produce
+    the SAME last-position logits as the dense full-prompt forward — the
+    chunked/one-shot equivalence that lets prefill interleave with decode."""
+    model, variables = request.getfixturevalue(lm)
+    p = variables["params"]
+    b, plen = 3, 9
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, size=(b, plen)).astype(np.int32)
+
+    out, _ = model.apply(
+        {"params": p, "state": {}}, {"tokens": jnp.asarray(prompt)},
+        mode="eval",
+    )
+    ref = np.asarray(out["logits"][:, -1].astype(jnp.float32))
+
+    cfg = model.config
+    h_kv = cfg.num_kv_heads or cfg.num_heads
+    bl, mb = 4, 8
+    spec = KVPoolSpec(num_layers=cfg.num_layers, num_blocks=1 + b * mb,
+                      block_len=bl, num_kv_heads=h_kv,
+                      head_dim=cfg.dim // cfg.num_heads)
+    kp, vp = spec.init_pages()
+    table = np.zeros((b, mb), np.int32)
+    for s in range(b):
+        table[s] = 1 + s * mb + np.arange(mb)
+    table = jnp.asarray(table)
+
+    # Chunked prefill of [0, plen-1) ...
+    for start in range(0, plen - 1, chunk):
+        piece = prompt[:, start:min(start + chunk, plen - 1)]
+        valid = np.full((b,), piece.shape[1], np.int32)
+        if piece.shape[1] < chunk:
+            piece = np.pad(piece, ((0, 0), (0, chunk - piece.shape[1])))
+        _, kp, vp = model.decode_step_paged(
+            p, jnp.asarray(piece), kp, vp, table,
+            jnp.full((b,), start, jnp.int32), jnp.asarray(valid),
+        )
+    # ... then the last prompt token through the C=1 decode shape.
+    logits, kp, vp = model.decode_step_paged(
+        p, jnp.asarray(prompt[:, -1:]), kp, vp, table,
+        jnp.full((b,), plen - 1, jnp.int32), jnp.ones((b,), jnp.int32),
+    )
+    got = np.asarray(logits.astype(jnp.float32))
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Engine: compiled-once + lifecycle
+# ---------------------------------------------------------------------------
+
+def _greedy_reference(model, variables, prompt, max_new):
+    full = generate(model, variables, prompt[None, :], max_new, temperature=0)
+    return np.asarray(full)[0, len(prompt):]
+
+
+def test_no_retrace_across_admission(tiny_lm):
+    """Admitting/evicting/refilling across a full 50-request synthetic
+    workload compiles the decode step and the prefill step exactly ONCE,
+    asserted both on the engine's trace counters and on the obs registry
+    gauges telemetry.json would carry."""
+    from rocket_tpu.obs.telemetry import Telemetry
+
+    model, variables = tiny_lm
+    telemetry = Telemetry(enabled=True)
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=48),
+        telemetry=telemetry,
+    )
+    rng = np.random.default_rng(11)
+    rids, prompts, maxnews = [], [], []
+    for _ in range(50):
+        plen = int(rng.integers(1, 14))
+        maxnew = int(rng.integers(1, 9))
+        prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+        prompts.append(prompt)
+        maxnews.append(maxnew)
+        rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                  temperature=0.0))
+    engine.drain()
+    report = engine.report()
+    assert report["requests"]["completed"] == 50
+    assert report["compiled"]["decode_traces"] == 1, report["compiled"]
+    assert report["compiled"]["prefill_traces"] == 1, report["compiled"]
+    # The registry carries the same proof (what serve_smoke greps out of
+    # telemetry.json in CI).
+    gauges = telemetry.registry.snapshot()["gauges"]
+    assert gauges["serve/decode_traces"] == 1
+    assert gauges["serve/prefill_traces"] == 1
+    assert gauges["serve/requests_completed"] == 50
+    # Pool HBM is slot-count math, not request-count math.
+    assert gauges["serve/kv_pool_bytes"] == engine.engine.spec.pool_bytes
+
+    # e2e correctness: every request's tokens == the generate() greedy
+    # reference for its prompt.
+    for rid, prompt, maxnew in zip(rids, prompts, maxnews):
+        ref = _greedy_reference(model, variables, prompt, maxnew)
+        got = np.asarray(engine.result(rid).tokens, np.int32)
+        np.testing.assert_array_equal(got, ref, err_msg=f"request {rid}")
+    # Per-request spans landed in the trace.
+    names = [e[0] for e in telemetry.spans.events()]
+    assert sum(1 for n in names if n.startswith("serve/request[")) == 50
+
+
+def test_eos_finishes_early_and_frees_slot(tiny_lm):
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=2, block_len=4, prefill_chunk=4,
+                    max_model_len=32),
+    )
+    prompt = np.asarray([5, 9, 2, 7], np.int32)
+    ref = _greedy_reference(model, variables, prompt, 6)
+    eos = int(ref[2])
+    # The request must stop at the FIRST greedy occurrence of eos.
+    first = int(np.nonzero(ref == eos)[0][0])
+    rid = engine.submit(prompt, max_new_tokens=6, temperature=0.0,
+                        eos_token_id=eos)
+    engine.drain()
+    req = engine.result(rid)
+    assert req.tokens == [int(t) for t in ref[:first + 1]]
+    assert req.tokens[-1] == eos
+    assert len(req.tokens) < 6  # actually finished early
+    # Slot + blocks released.
+    assert engine.scheduler.active_slots == 0
+    assert engine.scheduler.allocator.free_fraction == 1.0
+
+
+def test_eviction_backpressure_and_resume(tiny_lm):
+    """A pool too small for the offered load must preempt the youngest
+    request (blocks freed, request re-queued) and still finish EVERY
+    request with outputs identical to the uncontended reference."""
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=32, num_blocks=9),  # 8 allocatable
+    )
+    rng = np.random.default_rng(3)
+    rids, prompts, maxnews = [], [], []
+    for _ in range(8):
+        plen = int(rng.integers(4, 12))
+        maxnew = int(rng.integers(8, 16))
+        prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+        prompts.append(prompt)
+        maxnews.append(maxnew)
+        rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                  temperature=0.0))
+    engine.drain()
+    report = engine.report()
+    assert report["requests"]["completed"] == 8
+    assert report["requests"]["preemptions"] > 0
+    assert report["compiled"]["decode_traces"] == 1
+    for rid, prompt, maxnew in zip(rids, prompts, maxnews):
+        ref = _greedy_reference(model, variables, prompt, maxnew)
+        np.testing.assert_array_equal(
+            np.asarray(engine.result(rid).tokens, np.int32), ref,
+            err_msg=f"request {rid} diverged across preemption",
+        )
+    # Everything drained back to the pool.
+    assert engine.scheduler.allocator.free_fraction == 1.0
+
+
+def test_submit_validation(tiny_lm):
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=2, block_len=4, max_model_len=16,
+                    num_blocks=4),  # capacity 3 < the 4 a full seq needs
+    )
+    with pytest.raises(ValueError):  # empty prompt
+        engine.submit(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError):  # exceeds per-slot context
+        engine.submit(np.zeros((10,), np.int32), max_new_tokens=10)
+    with pytest.raises(ValueError):  # needs more blocks than the pool has
+        engine.submit(np.zeros((8,), np.int32), max_new_tokens=8)
+    with pytest.raises(ValueError):  # top_p <= 0 masks every token
+        engine.submit(np.zeros((2,), np.int32), temperature=0.9, top_p=0.0)
+    with pytest.raises(ValueError):  # oversized max_model_len vs model
+        ServeEngine(model, variables["params"],
+                    ServeConfig(max_model_len=1024))
+
+
+def test_completed_request_retention_cap_and_release(tiny_lm):
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=2, block_len=4, prefill_chunk=4,
+                    max_model_len=16, max_completed_requests=3),
+    )
+    rids = [engine.submit(np.asarray([1, 2], np.int32), max_new_tokens=2)
+            for _ in range(5)]
+    engine.drain()
+    # Only the newest 3 finished records survive the cap.
+    assert [r for r in rids if r in engine.requests] == rids[2:]
+    engine.release(rids[3])
+    assert rids[3] not in engine.requests
+    live = engine.submit(np.asarray([1], np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.release(live)  # still running
+    engine.drain()
+    # reset_metrics zeroes the aggregates but NEVER the trace counters.
+    engine.reset_metrics()
+    report = engine.report()
+    assert report["tokens_generated"] == 0
+    assert report["compiled"]["decode_traces"] == 1
+
+
+def test_generate_accepts_numpy_integer_scalars(tiny_lm):
+    """np.int64 scalars (rng.integers() output) must route to the scalar
+    path, not be mistaken for per-sequence arrays."""
+    model, variables = tiny_lm
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    a = np.asarray(generate(model, variables, prompt, 4, temperature=0))
+    b = np.asarray(generate(model, variables, prompt, np.int64(4),
+                            temperature=0, eos_token_id=np.int32(63)))
+    np.testing.assert_array_equal(a.shape, b.shape)
+    # numpy-integer top_k routes to the static lax.top_k path.
+    c = np.asarray(generate(model, variables, prompt, 4,
+                            key=jax.random.key(0), top_k=np.int32(1)))
+    np.testing.assert_array_equal(c, a)  # k=1 forces the argmax
+
+
+def test_streaming_and_per_slot_sampling(tiny_lm):
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=48),
+    )
+    prompt = np.asarray([1, 2, 3], np.int32)
+    greedy_rid = engine.submit(prompt, max_new_tokens=5, temperature=0.0)
+    sampled_rid = engine.submit(prompt, max_new_tokens=5, temperature=0.9,
+                                top_k=8, top_p=0.9)
+    streamed = list(engine.stream(greedy_rid))
+    assert streamed == engine.result(greedy_rid).tokens
+    np.testing.assert_array_equal(
+        np.asarray(streamed, np.int32),
+        _greedy_reference(model, variables, prompt, 5),
+    )
+    engine.drain()
+    sampled = engine.result(sampled_rid).tokens
+    assert len(sampled) == 5
+    assert all(0 <= t < 64 for t in sampled)
+    # Sampling knobs are RUNTIME arrays: mixing greedy and sampled slots
+    # in one engine never caused a second trace.
+    assert engine.engine.decode_traces == 1
+
+
+def test_gqa_rope_model_serves(llama_lm):
+    model, variables = llama_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=3, block_len=4, prefill_chunk=4,
+                    max_model_len=48),
+    )
+    rng = np.random.default_rng(5)
+    rids, prompts, maxnews = [], [], []
+    for _ in range(7):
+        plen = int(rng.integers(1, 10))
+        maxnew = int(rng.integers(1, 7))
+        prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+        prompts.append(prompt)
+        maxnews.append(maxnew)
+        rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                  temperature=0.0))
+    engine.drain()
+    for rid, prompt, maxnew in zip(rids, prompts, maxnews):
+        np.testing.assert_array_equal(
+            np.asarray(engine.result(rid).tokens, np.int32),
+            _greedy_reference(model, variables, prompt, maxnew),
+        )
+
+
+# ---------------------------------------------------------------------------
+# The shared sampling core / generate() satellite
+# ---------------------------------------------------------------------------
+
+def test_generate_per_sequence_limits_and_eos(tiny_lm):
+    """generate() accepts per-sequence max_new_tokens / eos_token_id as
+    runtime vectors: rows freeze at their own limits while the batch runs
+    to the longest, and the scalar path is unchanged."""
+    model, variables = tiny_lm
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    base = np.asarray(generate(model, variables, prompt, 6, temperature=0))
+    per = np.asarray(generate(
+        model, variables, prompt, np.asarray([2, 6]), temperature=0,
+    ))
+    assert per.shape == (2, 9)
+    # Row 0: its 2 tokens match the scalar run, then 0-fill (no eos).
+    np.testing.assert_array_equal(per[0, 3:5], base[0, 3:5])
+    assert (per[0, 5:] == 0).all()
+    # Row 1 is untouched by row 0's early freeze.
+    np.testing.assert_array_equal(per[1], base[1])
+    # Per-sequence eos: freeze row 0 on its first generated token.
+    eos_vec = np.asarray([int(base[0, 3]), -1], np.int32)
+    with_eos = np.asarray(generate(
+        model, variables, prompt, 6, temperature=0, eos_token_id=eos_vec,
+    ))
+    assert (with_eos[0, 3:] == int(base[0, 3])).all()
+    np.testing.assert_array_equal(with_eos[1], base[1])
+
+
+def test_sampling_core_array_scalar_parity():
+    """Per-row arrays with uniform values must sample exactly like the
+    scalar path modulo the per-row key derivation (greedy: identical)."""
+    from rocket_tpu.models.sampling import freeze_after_eos, sample_tokens
+
+    logits = jax.random.normal(jax.random.key(0), (4, 32))
+    key = jax.random.key(7)
+    greedy_scalar = sample_tokens(logits, key, 3, 0.0, None, None)
+    greedy_rows = sample_tokens(
+        logits, key, np.full((4,), 3, np.int32),
+        np.zeros((4,), np.float32), np.zeros((4,), np.int32),
+        np.ones((4,), np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(greedy_scalar), np.asarray(greedy_rows)
+    )
+    # top-k filter parity (deterministic part): k=1 forces the argmax.
+    top1 = sample_tokens(
+        logits, key, np.full((4,), 3, np.int32),
+        np.ones((4,), np.float32), np.ones((4,), np.int32),
+        np.ones((4,), np.float32),
+    )
+    np.testing.assert_array_equal(np.asarray(top1), np.asarray(greedy_scalar))
+    # freeze_after_eos array form: -1 disables, fill is 0 once done.
+    nxt = jnp.asarray([7, 7, 7], jnp.int32)
+    done = jnp.asarray([True, True, False])
+    eos = np.asarray([5, -1, 5], np.int32)
+    out, done2 = freeze_after_eos(nxt, done, eos)
+    np.testing.assert_array_equal(np.asarray(out), [5, 0, 7])
+    np.testing.assert_array_equal(np.asarray(done2), [True, True, False])
