@@ -824,6 +824,126 @@ def _sched_calibration(results):
     return entries
 
 
+#: Serving-budget directory the serve auditor maintains
+#: (``python -m rocket_tpu.analysis serve --update-budgets``).
+SERVE_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "serve",
+)
+
+
+def serve_audit_summary(serve=None, budgets_dir=SERVE_BUDGETS_DIR):
+    """Predicted serving latency/HBM + predicted-vs-measured calibration
+    for BENCH_DETAIL.json.
+
+    Two halves, both best-effort (None/partial on any failure):
+
+    * the committed serving-budget records (the numbers the serve
+      self-gate verifies every CI run): per-target predicted ITL/TTFT,
+      the analytic floor, the overfetch ratio and the engine HBM
+      footprint;
+    * a calibration leg re-predicting the ``charlm`` audit target —
+      configured byte-identically to :func:`serve_summary`'s engine —
+      priced for THIS run's device kind, against the serve record this
+      run just measured. ``itl_calibration_error`` is
+      (predicted - measured_p50) / measured_p50, same convention as
+      sched_audit's calibration; ``device_matched`` False means the
+      bench device's kind is absent from the peak table and the error
+      mostly measures that mismatch (e.g. the CPU-only CI container).
+    """
+    out = {}
+    try:
+        from rocket_tpu.analysis import budgets as budgets_mod
+
+        names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
+            if f.endswith(".json")
+        )
+        targets = {}
+        worst_itl = worst_ttft = worst_hbm = 0.0
+        for name in names:
+            record = budgets_mod.load_budget(budgets_dir, name)
+            if record is None:
+                continue
+            targets[name] = {
+                key: record.get(key)
+                for key in ("predicted_itl_us", "predicted_ttft_us",
+                            "itl_floor_us", "overfetch_ratio",
+                            "hbm_total_bytes", "host_bytes_per_wave",
+                            "device_kind")
+            }
+            worst_itl = max(worst_itl, record.get("predicted_itl_us") or 0)
+            worst_ttft = max(worst_ttft,
+                             record.get("predicted_ttft_us") or 0)
+            worst_hbm = max(worst_hbm, record.get("hbm_total_bytes") or 0)
+        if targets:
+            out = {
+                "targets": targets,
+                "predicted_itl_us": worst_itl,
+                "predicted_ttft_us": worst_ttft,
+                "hbm_total_bytes": int(worst_hbm),
+                "source": "tests/fixtures/budgets/serve",
+            }
+    except Exception:  # noqa: BLE001 — emission must never die on this
+        pass
+    try:
+        calibration = _serve_calibration(serve)
+        if calibration:
+            out["calibration"] = calibration
+    except Exception as exc:  # noqa: BLE001
+        log(f"bench: serve calibration failed: {exc!r}")
+    return out or None
+
+
+def _serve_calibration(serve):
+    """Re-predict the measured serve engine's ITL/TTFT with the static
+    roofline, priced for this run's device kind."""
+    if not serve:
+        return None
+    measured_itl_ms = (serve.get("itl_ms") or {}).get("p50")
+    measured_ttft_ms = (serve.get("ttft_ms") or {}).get("p50")
+    if not measured_itl_ms:
+        return None
+    from rocket_tpu.analysis.sched_audit import DEFAULT_DEVICE_KIND
+    from rocket_tpu.analysis.serve_audit import (
+        SERVE_TARGETS,
+        audit_serving,
+    )
+    from rocket_tpu.utils.perf import device_spec
+
+    kind = jax.devices()[0].device_kind
+    spec = device_spec(kind)
+    priced_kind = spec.kind if spec is not None else DEFAULT_DEVICE_KIND
+    target = SERVE_TARGETS["charlm"]
+    model, serve_cfg = target.build()
+    report = audit_serving(
+        model, serve_cfg, device_kind=priced_kind,
+        ref_prompt_len=target.ref_prompt_len, label="calib:serve",
+    )
+    predicted_itl = report.record.get("predicted_itl_us")
+    if not predicted_itl:
+        return None
+    measured_itl_us = measured_itl_ms * 1e3
+    entry = {
+        "predicted_itl_us": predicted_itl,
+        "measured_itl_us": round(measured_itl_us, 3),
+        "itl_calibration_error": round(
+            (predicted_itl - measured_itl_us) / measured_itl_us, 4
+        ),
+        "priced_for": priced_kind,
+        "device_matched": spec is not None,
+    }
+    predicted_ttft = report.record.get("predicted_ttft_us")
+    if predicted_ttft and measured_ttft_ms:
+        measured_ttft_us = measured_ttft_ms * 1e3
+        entry["predicted_ttft_us"] = predicted_ttft
+        entry["measured_ttft_us"] = round(measured_ttft_us, 3)
+        entry["ttft_calibration_error"] = round(
+            (predicted_ttft - measured_ttft_us) / measured_ttft_us, 4
+        )
+    return entry
+
+
 #: Where a telemetry-enabled bench run's record lands: bench trees carry
 #: no Tracker, so Runtime.end_training falls back to
 #: <project_dir>/runs/telemetry with project_dir "." — i.e. relative to
@@ -999,6 +1119,27 @@ def serve_summary(requests=64, warmup_requests=8):
         return None
 
 
+def _carry_calibration(section, prior_section):
+    """Merge a committed audit section's calibration entries under the
+    freshly-computed ones. A partial bench run only re-predicts the
+    configs it measured; the entries it could not recompute must survive
+    from the committed record or tracked model/reality drift silently
+    vanishes on every ``--config`` debug run."""
+    prior_cal = (prior_section or {}).get("calibration")
+    if not isinstance(prior_cal, dict) or not prior_cal:
+        return
+    fresh = section.get("calibration")
+    if not isinstance(fresh, dict) or not fresh:
+        # Nothing recomputed this run — carry the committed block whole.
+        section["calibration"] = prior_cal
+        return
+    # Per-config entries (sched: name -> entry dict) merge; a flat
+    # single-entry block (serve) was fully recomputed, so fresh wins.
+    for key, val in prior_cal.items():
+        if isinstance(val, dict) and key not in fresh:
+            fresh[key] = val
+
+
 def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
@@ -1006,17 +1147,20 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
 
     MERGES into an existing file rather than overwriting: a single-config
     debugging run (``--config gpt2``) must not clobber the committed
-    full-sweep record the stdout ``detail`` pointer references. Best
-    effort only — the caller guards it so a filesystem failure can never
-    eat the stdout line."""
+    full-sweep record the stdout ``detail`` pointer references — neither
+    its per-config records nor the audit calibration entries, which a
+    partial run cannot recompute (each needs that config's measured
+    value from THIS run). Best effort only — the caller guards it so a
+    filesystem failure can never eat the stdout line."""
     configs = {}
+    prior = {}
     try:
         with open(path) as f:
             prior = json.load(f)
         configs = {k: v for k, v in prior["configs"].items()
                    if isinstance(v, dict)}
     except Exception:  # noqa: BLE001 — any malformed prior starts fresh
-        pass
+        prior = {}
     for name, r in results.items():
         if "error" in r and "error" not in configs.get(name, {"error": 1}):
             # An errored re-run (debugging OOM, transient XLA failure) must
@@ -1047,6 +1191,7 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
         # Predicted step-time attribution (compute/memory/exposed-comm)
         # per audited target + predicted-vs-measured calibration for the
         # configs this run measured — model/reality drift is tracked.
+        _carry_calibration(sched, prior.get("sched_audit"))
         detail["sched_audit"] = sched
     telemetry = telemetry_summary()
     if telemetry is not None:
@@ -1064,6 +1209,13 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
         # batching tokens/sec + TTFT/ITL percentiles on the char-LM-sized
         # model, with the compiled-once trace counters alongside.
         detail["serve"] = serve
+    serve_audit = serve_audit_summary(serve, SERVE_BUDGETS_DIR)
+    if serve_audit is not None:
+        # Statically-predicted serving latency/HBM (serve_audit budgets)
+        # next to the measured serving record, plus the predicted-vs-
+        # measured ITL/TTFT calibration — model/reality drift is tracked.
+        _carry_calibration(serve_audit, prior.get("serve_audit"))
+        detail["serve_audit"] = serve_audit
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

@@ -1,7 +1,7 @@
 """CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` |
-``prec`` | ``sched``.
+``prec`` | ``sched`` | ``serve``.
 
-Four entry forms, one process contract (exit 0 = clean, 1 = findings,
+Five entry forms, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
 (:func:`~rocket_tpu.analysis.findings.emit_findings`):
 
@@ -22,7 +22,14 @@ Four entry forms, one process contract (exit 0 = clean, 1 = findings,
   (:mod:`rocket_tpu.analysis.sched_audit`): a per-op roofline cost
   model and a two-stream simulation attributing predicted step time to
   compute vs memory vs exposed communication, plus pallas block/VMEM
-  checks and the schedule budgets.
+  checks and the schedule budgets;
+* ``serve`` audits the *serving path*
+  (:mod:`rocket_tpu.analysis.serve_audit`): the real decode-wave /
+  prefill-chunk programs AOT-compiled and roofline-priced (predicted
+  ITL/TTFT per device kind), the scheduler driven through the full
+  admission lattice for the retrace-surface proof, KV-pool HBM fit
+  with the (slots, blocks) frontier, pool-donation/host-transfer
+  checks, and the serving budgets.
 
 The audit subcommands are one registry (:data:`AUDIT_SUBCOMMANDS`)
 sharing a single flag set and budget write/diff loop, so ``--format``
@@ -109,6 +116,15 @@ def _load_sched():
     return SCHED_TARGETS, run_sched_target
 
 
+def _load_serve():
+    from rocket_tpu.analysis.serve_audit import (
+        SERVE_TARGETS,
+        run_serve_target,
+    )
+
+    return SERVE_TARGETS, run_serve_target
+
+
 def _mesh_line(target) -> str:
     return (
         f"mesh={'x'.join(str(s) for s in target.mesh_shape.values())} "
@@ -154,6 +170,21 @@ AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
             list_line=lambda t: (
                 f"{_mesh_line(t)} device={t.device_kind}"
                 + ("" if t.compile_hlo else "  [jaxpr-only]")
+            ),
+        ),
+        AuditCLI(
+            name="serve",
+            description="static serving-path audit: retrace-surface "
+                        "proof over the admission lattice, decode/"
+                        "prefill latency roofline, KV-pool HBM fit, "
+                        "donation/host-transfer checks",
+            load=_load_serve,
+            budgets_dir_attr="SERVE_DIR",
+            gated_keys_attr="SERVE_GATED_KEYS",
+            budget_rule="RKT606",
+            family="serve",
+            list_line=lambda t: (
+                f"device={t.device_kind} ref_prompt={t.ref_prompt_len}"
             ),
         ),
     )
@@ -239,8 +270,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.analysis",
         description="rocketlint: static analysis for rocket_tpu fast "
-                    "paths (see also the `shard`, `prec` and `sched` "
-                    "subcommands)",
+                    "paths (see also the `shard`, `prec`, `sched` and "
+                    "`serve` subcommands)",
     )
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")

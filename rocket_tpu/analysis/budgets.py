@@ -43,6 +43,7 @@ __all__ = [
     "GATED_KEYS",
     "PREC_GATED_KEYS",
     "SCHED_GATED_KEYS",
+    "SERVE_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -65,13 +66,23 @@ PREC_GATED_KEYS = ("fp32_bytes_fraction", "widen_casts", "narrow_casts")
 #: predicted step time and the exposed (non-overlapped) collective time.
 SCHED_GATED_KEYS = ("predicted_step_time_us", "exposed_comm_us")
 
+#: Record keys the serving gate compares — RKT606. All three are
+#: monotone cost metrics of the AOT-compiled serving programs: predicted
+#: inter-token latency (one decode wave), predicted time-to-first-token
+#: (the chunked-prefill schedule for the target's reference prompt) and
+#: the engine's steady-state HBM footprint (pool + master params +
+#: compiled temps).
+SERVE_GATED_KEYS = ("predicted_itl_us", "predicted_ttft_us",
+                    "hbm_total_bytes")
+
 #: Default budgets directory, resolved relative to the repo checkout.
-#: The precision/schedule budgets live in ``prec/`` / ``sched/``
-#: subdirectories so BENCH's per-target sweep over ``*.json`` never
-#: mixes the record shapes.
+#: The precision/schedule/serving budgets live in ``prec/`` / ``sched/``
+#: / ``serve/`` subdirectories so BENCH's per-target sweep over
+#: ``*.json`` never mixes the record shapes.
 DEFAULT_DIR = os.path.join("tests", "fixtures", "budgets")
 PREC_DIR = os.path.join(DEFAULT_DIR, "prec")
 SCHED_DIR = os.path.join(DEFAULT_DIR, "sched")
+SERVE_DIR = os.path.join(DEFAULT_DIR, "serve")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -118,7 +129,9 @@ def diff_budget(
     silently gate nothing.
     """
     path = f"<{family}:{target}>"
-    subcommand = {"spmd": "shard", "sched": "sched"}.get(family, "prec")
+    subcommand = {
+        "spmd": "shard", "sched": "sched", "serve": "serve",
+    }.get(family, "prec")
     if committed is None:
         return [Finding(
             rule, path, 0,

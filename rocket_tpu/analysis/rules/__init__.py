@@ -29,11 +29,13 @@ from rocket_tpu.analysis.rules.jit_rules import (
     TracerLeakRule,
 )
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
+from rocket_tpu.analysis.rules.race_rules import UnlockedMutationRule
 from rocket_tpu.analysis.rules.sched_rules import SCHED_RULES
+from rocket_tpu.analysis.rules.serve_rules import SERVE_RULES
 from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
 __all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
-           "SCHED_RULES", "all_rules"]
+           "SCHED_RULES", "SERVE_RULES", "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -45,6 +47,7 @@ AST_RULES = (
     LaunchHostSyncRule(),
     ForkStartMethodRule(),
     StringDtypeRule(),
+    UnlockedMutationRule(),
 )
 
 #: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.
@@ -72,10 +75,10 @@ AUDIT_RULES = (
 
 def all_rules():
     """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
-    (RKT2xx), SPMD audit (RKT3xx), precision audit (RKT4xx) and schedule
-    audit (RKT5xx) — in id order."""
+    (RKT2xx), SPMD audit (RKT3xx), precision audit (RKT4xx), schedule
+    audit (RKT5xx) and serving audit (RKT6xx) — in id order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
     return tuple(sorted(
         ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
-        + list(SCHED_RULES)
+        + list(SCHED_RULES) + list(SERVE_RULES)
     ))

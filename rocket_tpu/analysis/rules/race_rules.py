@@ -1,0 +1,189 @@
+"""AST rule over threaded-host shared-state discipline.
+
+The host side of the framework has real thread concurrency: the obs
+registry and span recorder are written from worker pools and watchdog
+daemons, the flight recorder from crash paths, and a serving frontend's
+``submit()``/``step()``/``stream()`` may be driven from multiple request
+threads. The repo's convention is lock-per-owner: a class that owns
+shared mutable state holds exactly one ``threading.Lock``/``RLock`` and
+every mutation happens under ``with self._lock:``. This rule makes the
+convention checkable: in any class that OWNS a lock attribute, a method
+that mutates ``self`` state outside a ``with`` on that lock is a data
+race waiting for a second thread.
+
+Scope is deliberately tight to stay false-positive-free:
+
+* only classes that create a lock in their own body are checked — a
+  lock-free class states "single-threaded by design" and stays exempt;
+* ``__init__`` is exempt (construction happens-before sharing), as are
+  methods whose name ends in ``_locked`` (the documented caller-holds-
+  the-lock convention) and assignments to the lock attributes
+  themselves;
+* only ``self``-attribute mutations count: plain assignment, augmented
+  assignment, ``self.x[k] = v`` / ``del self.x[k]``, and calls of the
+  standard container mutators (``append``/``pop``/``update``/...).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["UnlockedMutationRule"]
+
+#: Call targets that create a lock object.
+_LOCK_FACTORIES = frozenset({
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+})
+
+#: Call targets whose attribute is thread-ISOLATED by construction —
+#: mutating `self.<attr>.x` needs no lock when `self.<attr>` is a
+#: threading.local().
+_THREAD_LOCAL_FACTORIES = frozenset({"threading.local", "local"})
+
+#: Method names that mutate a container in place.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> "x" (one level only; ``self.x.y`` resolves to "x")."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+class UnlockedMutationRule:
+    rule_id = "RKT109"
+    slug = "unlocked-shared-mutation"
+    contract = (
+        "a method of a lock-owning class mutates self state outside "
+        "`with self.<lock>:` — threaded callers (obs registry/watchdog "
+        "threads, serve request threads) race the mutation; hold the "
+        "owning lock or rename the method *_locked if the caller holds it"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = self._factory_attrs(cls, _LOCK_FACTORIES)
+            if not locks:
+                continue
+            exempt = locks | self._factory_attrs(
+                cls, _THREAD_LOCAL_FACTORIES
+            )
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__" or method.name.endswith("_locked"):
+                    continue
+                yield from self._check_method(
+                    ctx, cls, method, locks, exempt
+                )
+
+    @staticmethod
+    def _factory_attrs(cls: ast.ClassDef, factories) -> set:
+        """Attributes assigned a call of one of ``factories`` anywhere
+        in the class body."""
+        attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            if _dotted(node.value.func) not in factories:
+                continue
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    attrs.add(attr)
+        return attrs
+
+    def _check_method(self, ctx, cls, method, locks, exempt) -> Iterable[Finding]:
+        for node in ast.walk(method):
+            attr = self._mutated_attr(node)
+            if attr is None or attr in exempt:
+                continue
+            if self._under_lock(ctx, node, method, locks):
+                continue
+            yield Finding(
+                self.rule_id, ctx.path, node.lineno,
+                f"{cls.name}.{method.name} mutates self.{attr} without "
+                f"holding self.{sorted(locks)[0]} — a second thread "
+                "(registry flush, watchdog, serve submit/stream) races "
+                "this write; wrap it in `with "
+                f"self.{sorted(locks)[0]}:` or rename the method "
+                f"{method.name}_locked if every caller already holds it",
+            )
+
+    @staticmethod
+    def _mutated_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                # self.x = ..., self.x[k] = ..., self.x.y = ...
+                attr = _self_attr(target)
+                if attr is not None:
+                    return attr
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    return attr
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS):
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    return attr
+        return None
+
+    @staticmethod
+    def _under_lock(ctx, node, method, locks) -> bool:
+        """True when ``node`` sits inside ``with self.<lock>:`` (or the
+        lock is explicitly .acquire()d in this method — the rare manual
+        pattern; pairing acquire/release is on the author)."""
+        cursor = ctx.parents.get(node)
+        while cursor is not None and cursor is not method:
+            if isinstance(cursor, (ast.With, ast.AsyncWith)):
+                for item in cursor.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func  # self._lock() — not expected
+                    attr = _self_attr(expr)
+                    if attr in locks:
+                        return True
+            cursor = ctx.parents.get(cursor)
+        # Manual acquire anywhere in the method body.
+        for sub in ast.walk(method):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "acquire"
+                    and _self_attr(sub.func.value) in locks):
+                return True
+        return False

@@ -94,13 +94,17 @@ class FlightRecorder:
 
     def attach_checkpointer(self, checkpointer) -> None:
         """Called by the Checkpointer at setup; the first one wins (one
-        emergency writer is enough, and trees rarely carry two)."""
-        if self._checkpointer is None:
-            self._checkpointer = checkpointer
+        emergency writer is enough, and trees rarely carry two). Under
+        the lock: setup can race a watchdog-escalation dump reading the
+        checkpointer (RKT109)."""
+        with self._lock:
+            if self._checkpointer is None:
+                self._checkpointer = checkpointer
 
     def detach_checkpointer(self, checkpointer) -> None:
-        if self._checkpointer is checkpointer:
-            self._checkpointer = None
+        with self._lock:
+            if self._checkpointer is checkpointer:
+                self._checkpointer = None
 
     # -- recording ---------------------------------------------------------
 

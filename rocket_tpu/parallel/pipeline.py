@@ -262,11 +262,16 @@ def _build(
         return out, aux_total
 
     out_specs = (batch_spec, P()) if with_aux else batch_spec
+    # check_vma=False for the same reason as the 1F1B build below: the
+    # fill/drain lax.cond + ppermute carries trip jax's replication-rule
+    # table ("No replication rule for name") on some releases, and the
+    # out_specs already pin the replication contract we rely on.
     fn = shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(param_spec, batch_spec, P()),
         out_specs=out_specs,
+        check_vma=False,
     )
     # jit wrapper: the remat'ed stage body can't evaluate eagerly inside
     # shard_map; under an outer jit (the normal train step) this inlines.

@@ -117,6 +117,11 @@ def ring_attention_sharded(
     ``seq_axis`` (and batch optionally over ``data_axis``)."""
     batch = data_axis if (data_axis and data_axis in mesh.shape) else None
     spec = P(batch, None, seq_axis, None)
+    # check_vma=False for the same reason as the pipeline shard_maps: the
+    # ppermute rotation inside the fori_loop carry trips jax's
+    # replication-rule table on some releases ("Scan carry ... mismatched
+    # replication types"), and the out_specs already pin the replication
+    # contract we rely on.
     fn = shard_map(
         functools.partial(
             ring_attention,
@@ -127,5 +132,6 @@ def ring_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=False,
     )
     return fn(q, k, v)

@@ -16,6 +16,7 @@ turns on back-pressure testing). ``docs/serving.md`` walks the math.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Iterator, Optional, Union
@@ -50,6 +51,33 @@ class ServeConfig:
     #: long-running server's host memory stays bounded (``release()``
     #: drops one eagerly).
     max_completed_requests: int = 4096
+
+    def resolve(self, model_config) -> tuple[KVPoolSpec, int, int]:
+        """``(pool_spec, max_blocks_per_seq, num_blocks)`` for a model.
+
+        THE sizing math — one implementation shared by the live engine
+        and the static serving auditor
+        (``rocket_tpu.analysis.serve_audit``), so the audited pool is
+        byte-identical to the served one."""
+        mc = model_config
+        h_kv = mc.num_kv_heads or mc.num_heads
+        max_len = self.max_model_len or mc.max_seq_len
+        if max_len > mc.max_seq_len:
+            raise ValueError(
+                f"ServeConfig.max_model_len {max_len} exceeds the model's "
+                f"max_seq_len {mc.max_seq_len}"
+            )
+        mb = -(-max_len // self.block_len)  # ceil: blocks per sequence
+        num_blocks = self.num_blocks or (1 + self.max_slots * mb)
+        spec = KVPoolSpec(
+            num_layers=mc.num_layers,
+            num_blocks=num_blocks,
+            block_len=self.block_len,
+            num_kv_heads=h_kv,
+            head_dim=mc.dim // mc.num_heads,
+            dtype=self.dtype or mc.activation_dtype or "float32",
+        )
+        return spec, mb, num_blocks
 
 
 class StreamDetokenizer:
@@ -102,24 +130,7 @@ class ServeEngine:
         key=None,
     ) -> None:
         cfg = config or ServeConfig()
-        mc = model.config
-        h_kv = mc.num_kv_heads or mc.num_heads
-        max_len = cfg.max_model_len or mc.max_seq_len
-        if max_len > mc.max_seq_len:
-            raise ValueError(
-                f"ServeConfig.max_model_len {max_len} exceeds the model's "
-                f"max_seq_len {mc.max_seq_len}"
-            )
-        mb = -(-max_len // cfg.block_len)  # ceil: blocks per sequence
-        num_blocks = cfg.num_blocks or (1 + cfg.max_slots * mb)
-        spec = KVPoolSpec(
-            num_layers=mc.num_layers,
-            num_blocks=num_blocks,
-            block_len=cfg.block_len,
-            num_kv_heads=h_kv,
-            head_dim=mc.dim // mc.num_heads,
-            dtype=cfg.dtype or mc.activation_dtype or "float32",
-        )
+        spec, mb, num_blocks = cfg.resolve(model.config)
         self.config = cfg
         self.engine = SlotEngine(
             model, params, spec,
@@ -131,6 +142,12 @@ class ServeEngine:
         self.scheduler = Scheduler(self.engine, BlockAllocator(num_blocks))
         self.tokenizer = tokenizer
         self.telemetry = telemetry
+        #: Owns every mutable record below AND the scheduler/engine tick
+        #: path: ``submit``/``step``/``release``/``reset_metrics`` may be
+        #: called from concurrent request threads (``stream()`` readers
+        #: step the engine), and the host mirrors must never interleave
+        #: with a wave in flight (RKT109 race lint).
+        self._lock = threading.Lock()
         self.requests: dict[int, Request] = {}
         self._finished_order: list[int] = []  # completion-ordered rids
         # Latency records (seconds), trimmed to a bounded tail so week-long
@@ -172,46 +189,49 @@ class ServeEngine:
             top_p=top_p,
             eos_token_id=eos_token_id,
         )
-        rid = self.scheduler.submit(req)
-        self.requests[rid] = req
+        with self._lock:
+            rid = self.scheduler.submit(req)
+            self.requests[rid] = req
         return rid
 
     # -- stepping ----------------------------------------------------------
 
     def step(self) -> list[TickEvent]:
         """One scheduling round; records latency metrics and publishes the
-        obs gauges."""
-        events = self.scheduler.tick()
-        self._ticks += 1
-        self._occupancy_sum += self.scheduler.active_slots
-        now = time.perf_counter()
-        if events:
-            if self._first_wave_at is None:
-                self._first_wave_at = now
-            self._last_event_at = now
-        for ev in events:
-            req = ev.request
-            prev = self._last_emit.get(req.id)
-            if prev is None:
-                self._ttft.append(req.first_token_at - req.submitted_at)
-            else:
-                # Inter-token latency: the wave cadence this request saw.
-                self._itl.append(now - prev)
-            if ev.finished:
-                self._last_emit.pop(req.id, None)
-                self._finish_span(req)
-                self._retire(req.id)
-            else:
-                self._last_emit[req.id] = now
-        del self._ttft[:-self._latency_cap]
-        del self._itl[:-self._latency_cap]
-        self._publish()
-        return events
+        obs gauges. Serialized under the engine lock — concurrent
+        ``stream()`` readers may each drive ``step()``."""
+        with self._lock:
+            events = self.scheduler.tick()
+            self._ticks += 1
+            self._occupancy_sum += self.scheduler.active_slots
+            now = time.perf_counter()
+            if events:
+                if self._first_wave_at is None:
+                    self._first_wave_at = now
+                self._last_event_at = now
+            for ev in events:
+                req = ev.request
+                prev = self._last_emit.get(req.id)
+                if prev is None:
+                    self._ttft.append(req.first_token_at - req.submitted_at)
+                else:
+                    # Inter-token latency: the wave cadence this request saw.
+                    self._itl.append(now - prev)
+                if ev.finished:
+                    self._last_emit.pop(req.id, None)
+                    self._finish_span(req)
+                    self._retire_locked(req.id)
+                else:
+                    self._last_emit[req.id] = now
+            del self._ttft[:-self._latency_cap]
+            del self._itl[:-self._latency_cap]
+            self._publish()
+            return events
 
-    def _retire(self, rid: int) -> None:
+    def _retire_locked(self, rid: int) -> None:
         """Bound the completed-request record: keep the newest
         ``max_completed_requests`` finished Requests readable, drop the
-        oldest beyond that."""
+        oldest beyond that. Caller holds ``self._lock``."""
         self._finished_order.append(rid)
         cap = max(self.config.max_completed_requests, 0)
         while len(self._finished_order) > cap:
@@ -221,14 +241,17 @@ class ServeEngine:
     def release(self, rid: int) -> None:
         """Drop a finished request's record eagerly (long-running servers
         that consume results as they stream need no retention at all)."""
-        req = self.requests.get(rid)
-        if req is not None and not req.finished:
-            raise ValueError(f"ServeEngine.release: request {rid} still live")
-        self.requests.pop(rid, None)
-        try:
-            self._finished_order.remove(rid)
-        except ValueError:
-            pass
+        with self._lock:
+            req = self.requests.get(rid)
+            if req is not None and not req.finished:
+                raise ValueError(
+                    f"ServeEngine.release: request {rid} still live"
+                )
+            self.requests.pop(rid, None)
+            try:
+                self._finished_order.remove(rid)
+            except ValueError:
+                pass
 
     def drain(self, max_ticks: int = 100_000) -> list[TickEvent]:
         """Step until every submitted request completed."""
@@ -312,21 +335,29 @@ class ServeEngine:
         while idle (e.g. after a warmup ``drain()``): benchmarks warm the
         compiled steps with a few requests, reset, then measure
         steady-state serving without compile time in the percentiles."""
-        self._ttft.clear()
-        self._itl.clear()
-        self._first_wave_at = None
-        self._last_event_at = None
-        self._occupancy_sum = 0
-        self._ticks = 0
-        sched = self.scheduler
-        sched.submitted = sched.queue_depth + sched.active_slots
-        sched.completed = 0
-        sched.preemptions = 0
-        sched.tokens_generated = 0
-        sched.waves_idle = 0
+        with self._lock:
+            self._ttft.clear()
+            self._itl.clear()
+            self._first_wave_at = None
+            self._last_event_at = None
+            self._occupancy_sum = 0
+            self._ticks = 0
+            sched = self.scheduler
+            sched.submitted = sched.queue_depth + sched.active_slots
+            sched.completed = 0
+            sched.preemptions = 0
+            sched.tokens_generated = 0
+            sched.waves_idle = 0
 
     def report(self) -> dict:
-        """Latency/throughput summary for this engine's lifetime."""
+        """Latency/throughput summary for this engine's lifetime.
+
+        Reads the lock-owned aggregates, so a snapshot taken during a
+        concurrent ``step()``/``reset_metrics()`` is never torn."""
+        with self._lock:
+            return self._report_locked()
+
+    def _report_locked(self) -> dict:
         sched = self.scheduler
         busy = None
         if self._first_wave_at is not None and self._last_event_at is not None:

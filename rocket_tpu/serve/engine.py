@@ -20,15 +20,25 @@ body re-executes only on retrace), which the obs registry exposes as
 ``serve/decode_traces`` / ``serve/prefill_traces``: the serve test suite
 and smoke assert both stay at 1 across 50+ admissions.
 
-Pool buffers are DONATED through both programs, so the pool is updated in
-place wave over wave; the one host sync per wave is the explicit
-``jax.device_get`` of the sampled tokens — serving has to observe them to
-stream, and it is a few hundred bytes.
+The step functions themselves are built by the MODULE-LEVEL builders
+:func:`build_decode_wave` / :func:`build_prefill_step` (pure functions of
+their arguments, jitted by the engine at construction), and
+:func:`abstract_wave_inputs` produces matching ``ShapeDtypeStruct``
+argument tuples — which is what lets the static serving auditor
+(``rocket_tpu.analysis.serve_audit``) AOT-compile the REAL programs on a
+fake backend and prove the retrace/HBM/latency story before any request
+is served.
+
+Pool buffers are DONATED through both programs (:data:`DECODE_DONATE` /
+:data:`PREFILL_DONATE`), so the pool is updated in place wave over wave;
+the one host sync per wave is the explicit ``jax.device_get`` of the
+sampled tokens — serving has to observe them to stream, and it is a few
+hundred bytes.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +46,136 @@ import jax.numpy as jnp
 from rocket_tpu.models.sampling import freeze_after_eos, sample_tokens
 from rocket_tpu.serve.kv_pool import KVPoolSpec
 
-__all__ = ["SlotEngine"]
+__all__ = [
+    "SlotEngine",
+    "build_decode_wave",
+    "build_prefill_step",
+    "abstract_wave_inputs",
+    "DECODE_DONATE",
+    "PREFILL_DONATE",
+]
+
+#: Donated argument positions of the two compiled programs — the pool
+#: buffers (k_pages, v_pages). One definition shared by the engine's jit
+#: and the static auditor's AOT compile, so they cannot disagree.
+DECODE_DONATE = (1, 2)
+PREFILL_DONATE = (1, 2)
+
+
+def build_decode_wave(model, on_trace: Optional[Callable] = None) -> Callable:
+    """The decode-wave step function for ``model`` — PURE in its
+    arguments (params and pool buffers are inputs, not closure state).
+
+    ``on_trace`` is invoked at TRACE time inside the body (the engine
+    passes its retrace counter; the auditor passes its own). Signature::
+
+        decode_wave(params, k_pages, v_pages, block_table, lengths,
+                    last_tok, run_mask, limits, temp, top_k, top_p,
+                    eos, salts, key) -> (k_pages, v_pages, next, done)
+    """
+
+    def decode_wave(params, k_pages, v_pages, block_table, lengths,
+                    last_tok, run_mask, limits, temp, top_k, top_p,
+                    eos, salts, key):
+        if on_trace is not None:
+            on_trace()  # trace-time: counts (re)traces only
+        valid = run_mask.astype(jnp.int32)
+        logits, k_pages, v_pages = model.decode_step_paged(
+            params, last_tok[:, None], k_pages, v_pages, block_table,
+            lengths, valid,
+        )
+        nxt = sample_tokens(
+            logits, key, salts, temp, top_k, top_p
+        ).astype(jnp.int32)
+        done = jnp.zeros(nxt.shape, bool)
+        nxt, done = freeze_after_eos(nxt, done, eos)
+        done = done | (lengths + valid >= limits)
+        # Masked slots: hold their token (host state stays coherent).
+        nxt = jnp.where(run_mask, nxt, last_tok)
+        return k_pages, v_pages, nxt, done & run_mask
+
+    return decode_wave
+
+
+def build_prefill_step(model, on_trace: Optional[Callable] = None) -> Callable:
+    """The prefill-chunk step function for ``model``; see
+    :func:`build_decode_wave` for the builder contract. Signature::
+
+        prefill_chunk(params, k_pages, v_pages, block_table_row,
+                      tokens, positions, valid) -> (k_pages, v_pages)
+    """
+
+    def prefill_chunk_fn(params, k_pages, v_pages, block_table, tokens,
+                         positions, valid):
+        if on_trace is not None:
+            on_trace()  # trace-time: counts (re)traces only
+        _, k_pages, v_pages = model.decode_step_paged(
+            params, tokens, k_pages, v_pages, block_table,
+            positions, valid,
+        )
+        return k_pages, v_pages
+
+    return prefill_chunk_fn
+
+
+def abstract_wave_inputs(
+    model,
+    spec: KVPoolSpec,
+    *,
+    max_slots: int,
+    max_blocks_per_seq: int,
+    prefill_chunk: int,
+    abs_params=None,
+):
+    """``(decode_args, prefill_args)`` — ``ShapeDtypeStruct`` tuples
+    matching the two step functions' signatures, for zero-FLOP AOT
+    compilation (``jax.jit(fn).lower(*args).compile()``).
+
+    ``abs_params`` defaults to ``jax.eval_shape(model.init)['params']``
+    run through the same activation-dtype master-cast the engine applies
+    (``_decode_params`` evaluated abstractly), so the audited programs
+    see exactly the dtypes the live engine feeds.
+    """
+    from rocket_tpu.models.transformer import _decode_params
+
+    if abs_params is None:
+        abs_params = jax.eval_shape(model.init, jax.random.key(0))["params"]
+    abs_params = jax.eval_shape(
+        lambda p: _decode_params(p, model.config.activation_dtype), abs_params
+    )
+    s, mb, c = int(max_slots), int(max_blocks_per_seq), int(prefill_chunk)
+    pool_shape = (
+        spec.num_layers, spec.num_blocks, spec.block_len,
+        spec.num_kv_heads, spec.head_dim,
+    )
+    pool = jax.ShapeDtypeStruct(pool_shape, jnp.dtype(spec.dtype))
+    i32 = jnp.int32
+    f32 = jnp.float32
+    vec_i = jax.ShapeDtypeStruct((s,), i32)
+    vec_f = jax.ShapeDtypeStruct((s,), f32)
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    decode_args = (
+        abs_params, pool, pool,
+        jax.ShapeDtypeStruct((s, mb), i32),   # block_table
+        vec_i,                                # lengths
+        vec_i,                                # last_tok
+        jax.ShapeDtypeStruct((s,), jnp.bool_),  # run_mask
+        vec_i,                                # limits
+        vec_f,                                # temp
+        vec_i,                                # top_k
+        vec_f,                                # top_p
+        vec_i,                                # eos
+        vec_i,                                # salts
+        key,
+    )
+    prefill_args = (
+        abs_params, pool, pool,
+        jax.ShapeDtypeStruct((1, mb), i32),   # block_table row
+        jax.ShapeDtypeStruct((1, c), i32),    # tokens
+        jax.ShapeDtypeStruct((1,), i32),      # position
+        jax.ShapeDtypeStruct((1,), i32),      # valid
+    )
+    return decode_args, prefill_args
 
 
 class SlotEngine:
@@ -84,36 +223,20 @@ class SlotEngine:
         self.decode_waves = 0
         self.prefill_chunks = 0
 
-        def decode_wave(params, k_pages, v_pages, block_table, lengths,
-                        last_tok, run_mask, limits, temp, top_k, top_p,
-                        eos, salts, key):
-            self.decode_traces += 1  # trace-time: counts (re)traces only
-            valid = run_mask.astype(jnp.int32)
-            logits, k_pages, v_pages = model.decode_step_paged(
-                params, last_tok[:, None], k_pages, v_pages, block_table,
-                lengths, valid,
-            )
-            nxt = sample_tokens(
-                logits, key, salts, temp, top_k, top_p
-            ).astype(jnp.int32)
-            done = jnp.zeros(nxt.shape, bool)
-            nxt, done = freeze_after_eos(nxt, done, eos)
-            done = done | (lengths + valid >= limits)
-            # Masked slots: hold their token (host state stays coherent).
-            nxt = jnp.where(run_mask, nxt, last_tok)
-            return k_pages, v_pages, nxt, done & run_mask
+        def count_decode():
+            self.decode_traces += 1
 
-        def prefill_chunk_fn(params, k_pages, v_pages, block_table, tokens,
-                             positions, valid):
-            self.prefill_traces += 1  # trace-time: counts (re)traces only
-            _, k_pages, v_pages = model.decode_step_paged(
-                params, tokens, k_pages, v_pages, block_table,
-                positions, valid,
-            )
-            return k_pages, v_pages
+        def count_prefill():
+            self.prefill_traces += 1
 
-        self._decode = jax.jit(decode_wave, donate_argnums=(1, 2))
-        self._prefill = jax.jit(prefill_chunk_fn, donate_argnums=(1, 2))
+        self._decode = jax.jit(
+            build_decode_wave(model, on_trace=count_decode),
+            donate_argnums=DECODE_DONATE,
+        )
+        self._prefill = jax.jit(
+            build_prefill_step(model, on_trace=count_prefill),
+            donate_argnums=PREFILL_DONATE,
+        )
 
     # -- compiled-step drivers ---------------------------------------------
 

@@ -34,8 +34,10 @@ class DeviceSpec:
     inter-chip bandwidth per chip (all links). ``vmem_bytes`` is a
     CONSERVATIVE per-core scratch budget for pallas kernels, not the
     hardware maximum — a kernel fitting this budget leaves the compiler
-    headroom for its own spills. ``ridge`` (FLOPs/byte) is the
-    arithmetic intensity above which a kernel is compute-bound.
+    headroom for its own spills. ``hbm_bytes`` is the per-chip HBM
+    CAPACITY (the published figure; the serving auditor's RKT603 fit
+    check budgets against it). ``ridge`` (FLOPs/byte) is the arithmetic
+    intensity above which a kernel is compute-bound.
     """
 
     kind: str
@@ -43,6 +45,7 @@ class DeviceSpec:
     hbm_bw: float
     ici_bw: float
     vmem_bytes: int
+    hbm_bytes: int = 16 << 30
 
     @property
     def ridge(self) -> float:
@@ -56,12 +59,16 @@ class DeviceSpec:
 DEVICE_SPECS = {
     spec.kind: spec
     for spec in (
-        DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 16 << 20),
-        DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 16 << 20),  # v5e
-        DeviceSpec("TPU v5", 459e12, 2765e9, 600e9, 16 << 20),      # v5p
-        DeviceSpec("TPU v6 lite", 918e12, 1638e9, 448e9, 32 << 20),  # v6e
-        DeviceSpec("TPU v6", 918e12, 1638e9, 448e9, 32 << 20),
-        DeviceSpec("TPU v7", 2307e12, 7370e9, 1200e9, 32 << 20),
+        DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 16 << 20, 32 << 30),
+        DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 16 << 20,
+                   16 << 30),                                        # v5e
+        DeviceSpec("TPU v5", 459e12, 2765e9, 600e9, 16 << 20,
+                   95 << 30),                                        # v5p
+        DeviceSpec("TPU v6 lite", 918e12, 1638e9, 448e9, 32 << 20,
+                   32 << 30),                                        # v6e
+        DeviceSpec("TPU v6", 918e12, 1638e9, 448e9, 32 << 20, 32 << 30),
+        DeviceSpec("TPU v7", 2307e12, 7370e9, 1200e9, 32 << 20,
+                   192 << 30),
     )
 }
 

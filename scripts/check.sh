@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
 # SPMD shard audit (self-gate + budget diff) + precision audit
-# (dtype-flow self-gate + numerics budgets) + obs telemetry smoke +
-# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on
-# the first failing stage.
+# (dtype-flow self-gate + numerics budgets) + schedule audit + serving
+# audit (retrace-surface/latency/HBM self-gate + serving budgets) +
+# obs telemetry smoke + the tier-1 test suite (command from
+# ROADMAP.md). Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -38,6 +39,15 @@ echo "== schedule audit (roofline self-gate + schedule budgets) =="
 # tests/fixtures/budgets/sched/.
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
     --budgets tests/fixtures/budgets/sched
+
+echo "== serving audit (retrace-surface / latency-roofline / HBM-fit self-gate + serving budgets) =="
+# AOT-compiles the real decode-wave/prefill programs and drives the real
+# scheduler through the admission lattice; fails on serving findings
+# (RKT601-605: retrace surface, decode overfetch, pool HBM overflow,
+# donation/host-transfer, latency ceilings) or a >10% predicted-ITL/
+# TTFT/HBM regression over tests/fixtures/budgets/serve/.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis serve \
+    --budgets tests/fixtures/budgets/serve
 
 echo "== obs smoke (telemetry + health sentinels + strict step path) =="
 # Tier-1 example run with telemetry AND health sentinels on:

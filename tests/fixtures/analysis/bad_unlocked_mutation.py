@@ -1,0 +1,32 @@
+"""RKT109 true positives: a lock-owning class mutating shared state
+outside the lock."""
+
+import threading
+
+
+class LeakyRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._events = []
+        self.total = 0
+
+    def bump(self, name):
+        # Plain dict item assignment without the lock.
+        self._counts[name] = self._counts.get(name, 0) + 1
+
+    def note(self, event):
+        # Container mutator without the lock.
+        self._events.append(event)
+
+    def accumulate(self, n):
+        # Augmented assignment without the lock.
+        self.total += n
+
+    def trim(self):
+        # del on shared state without the lock.
+        del self._events[:-10]
+
+    def locked_ok(self, name):
+        with self._lock:
+            self._counts[name] = 0

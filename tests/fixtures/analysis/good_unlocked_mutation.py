@@ -1,0 +1,49 @@
+"""RKT109 clean negatives: lock discipline held (or no lock owned)."""
+
+import threading
+
+
+class DisciplinedRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}     # construction happens-before sharing
+        self._events = []
+        self._local = threading.local()
+
+    def bump(self, name):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def drain(self):
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        return out
+
+    def _merge_locked(self, other):
+        # *_locked convention: the caller holds the lock.
+        self._counts.update(other)
+
+    def scratch(self, item):
+        # threading.local attributes are thread-isolated by construction.
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(item)
+
+    def manual(self, name):
+        self._lock.acquire()
+        try:
+            self._counts[name] = 0
+        finally:
+            self._lock.release()
+
+
+class SingleThreaded:
+    """No lock owned: single-threaded by design, rule does not apply."""
+
+    def __init__(self):
+        self.items = []
+
+    def add(self, item):
+        self.items.append(item)

@@ -44,6 +44,7 @@ AST_CASES = [
     ("RKT106", "launch_host_sync"),
     ("RKT107", "fork_start_method"),
     ("RKT108", "string_dtype"),
+    ("RKT109", "unlocked_mutation"),
 ]
 
 

@@ -1,10 +1,11 @@
-"""The analysis CLI process contract, for all four entry forms.
+"""The analysis CLI process contract, for all five entry forms.
 
 ``python -m rocket_tpu.analysis`` (rocketlint over paths), ``... shard``
-(the SPMD auditor), ``... prec`` (the dtype-flow auditor) and
-``... sched`` (the roofline/schedule auditor) must hold the same machine
-contract CI scripts depend on: exit 0 on a clean tree, 1 on findings, 2
-on usage errors, and one ``--format json`` output shape. The audit
+(the SPMD auditor), ``... prec`` (the dtype-flow auditor), ``... sched``
+(the roofline/schedule auditor) and ``... serve`` (the serving-path
+auditor) must hold the same machine contract CI scripts depend on: exit
+0 on a clean tree, 1 on findings, 2 on usage errors, and one
+``--format json`` output shape. The audit
 subcommands share one registry (``__main__.AUDIT_SUBCOMMANDS``), so the
 contract rows are parameterized over it. Everything runs as a real
 subprocess under ``JAX_PLATFORMS=cpu`` — the audit subcommands provision
@@ -57,11 +58,12 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_five_families():
+def test_list_rules_includes_all_six_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
-    for rule_id in ("RKT101", "RKT108", "RKT201", "RKT301", "RKT306",
-                    "RKT401", "RKT406", "RKT501", "RKT506"):
+    for rule_id in ("RKT101", "RKT108", "RKT109", "RKT201", "RKT301",
+                    "RKT306", "RKT401", "RKT406", "RKT501", "RKT506",
+                    "RKT601", "RKT606"):
         assert rule_id in proc.stdout
 
 
@@ -72,10 +74,10 @@ def test_audit_registry_covers_every_subcommand():
     flag set and exit-code handling through it."""
     from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
 
-    assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched"}
+    assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve"}
 
 
-@pytest.mark.parametrize("sub", ["shard", "prec", "sched"])
+@pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve"])
 def test_every_audit_subcommand_holds_the_usage_contract(sub):
     assert run_cli(sub, "--target", "nope").returncode == 2
     assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
@@ -262,6 +264,69 @@ def test_sched_badpallas_reports_block_misfits():
     assert proc.returncode == 1
     rules = {f["rule"] for f in json.loads(proc.stdout)}
     assert rules == {"RKT504"}
+
+
+# -- serve form --------------------------------------------------------------
+
+SERVE_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "serve")
+
+
+def test_serve_list_targets():
+    proc = run_cli("serve", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tiny", "charlm", "gpt2_geom", "badserve"):
+        assert name in proc.stdout
+    assert "[demo]" in proc.stdout
+
+
+def test_serve_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: the repo's own serve configs — the real
+    decode/prefill programs AOT-compiled, the real scheduler driven
+    through the admission lattice — with the committed serving budgets:
+    zero findings, exit 0."""
+    proc = run_cli("serve", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets", "serve"),
+                   timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_serve_badserve_reports_all_five_rules():
+    """True positives through the real CLI: the seeded-bad serve config
+    (python-int in the wave signature, oversized pool, no donation,
+    unreachable ceilings) must surface every RKT60x family, exit 1, in
+    the shared JSON shape."""
+    proc = run_cli("serve", "--target", "badserve", "--format", "json")
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert set(findings[0]) == {"rule", "path", "line", "message"}
+    rules = {f["rule"] for f in findings}
+    assert rules == {"RKT601", "RKT602", "RKT603", "RKT604", "RKT605"}
+
+
+@pytest.mark.slow
+def test_serve_budget_regression_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: shrink the committed predicted ITL by half
+    (equivalently: the prediction grew 2x) -> RKT606, exit 1;
+    --update-budgets re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "serve"
+    budgets_dir.mkdir()
+    committed = json.load(open(os.path.join(SERVE_BUDGETS, "tiny.json")))
+    committed["predicted_itl_us"] = committed["predicted_itl_us"] * 0.5
+    (budgets_dir / "tiny.json").write_text(json.dumps(committed))
+
+    proc = run_cli("serve", "--target", "tiny",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT606" in proc.stdout
+    assert "predicted_itl_us" in proc.stdout
+
+    proc = run_cli("serve", "--target", "tiny",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+
+    proc = run_cli("serve", "--target", "tiny",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 @pytest.mark.slow

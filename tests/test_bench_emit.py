@@ -14,6 +14,8 @@ import json
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import bench  # noqa: E402
@@ -137,6 +139,45 @@ def test_write_detail_errored_rerun_keeps_good_record(tmp_path):
     assert rec["value"] == 42.0 and "last_error" not in rec
 
 
+def test_write_detail_carries_audit_calibration_across_partial_runs(
+        tmp_path):
+    """A partial run cannot recompute calibration entries (each needs
+    that config's measured value from THIS run) — the committed blocks
+    must survive, per-config for sched and whole for serve."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    detail = json.loads(path.read_text())
+    sched_cal = {"charlm": {"calibration_error": 1.5, "priced_for": "x"},
+                 "resnet18": {"calibration_error": 0.9}}
+    serve_cal = {"itl_calibration_error": -0.5, "predicted_itl_us": 10.0}
+    detail.setdefault("sched_audit", {})["calibration"] = sched_cal
+    detail.setdefault("serve_audit", {})["calibration"] = serve_cal
+    path.write_text(json.dumps(detail))
+    # A run that measured NO calibration config keeps both blocks whole.
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    detail = json.loads(path.read_text())
+    assert detail["sched_audit"]["calibration"] == sched_cal
+    assert detail["serve_audit"]["calibration"] == serve_cal
+
+
+def test_carry_calibration_merges_per_config_and_replaces_flat():
+    # Per-config (sched): a fresh entry wins, missing configs carry.
+    section = {"calibration": {"charlm": {"calibration_error": 2.0}}}
+    bench._carry_calibration(section, {"calibration": {
+        "charlm": {"calibration_error": 1.0},
+        "resnet18": {"calibration_error": 0.5},
+    }})
+    assert section["calibration"]["charlm"]["calibration_error"] == 2.0
+    assert section["calibration"]["resnet18"]["calibration_error"] == 0.5
+    # Flat single-entry (serve): a fresh block replaces wholesale —
+    # stale scalar keys from the prior run must not bleed in.
+    section = {"calibration": {"itl_calibration_error": 0.1}}
+    bench._carry_calibration(section, {"calibration": {
+        "itl_calibration_error": 0.9, "ttft_calibration_error": 0.8,
+    }})
+    assert section["calibration"] == {"itl_calibration_error": 0.1}
+
+
 def test_write_detail_survives_corrupt_prior(tmp_path):
     path = tmp_path / "BENCH_DETAIL.json"
     for corrupt in ("{not json", "[1,2]", '"a string"', ""):
@@ -177,6 +218,55 @@ def test_write_detail_carries_prec_audit_record(tmp_path):
     target = audit["targets"]["tp_2x4"]
     assert 0.0 < target["fp32_bytes_fraction"] < 1.0
     assert target["widen_casts"] > 0
+
+
+def test_write_detail_carries_serve_audit_record(tmp_path):
+    """BENCH_DETAIL.json carries the statically-predicted serving
+    latency/HBM record (from the committed serving budgets the serve
+    CI gate verifies), and — when a measured serve record rides along —
+    the predicted-vs-measured ITL calibration."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    audit = json.loads(path.read_text())["serve_audit"]
+    assert audit["predicted_itl_us"] > 0
+    assert audit["predicted_ttft_us"] > audit["predicted_itl_us"]
+    assert audit["hbm_total_bytes"] > 0
+    assert audit["source"] == "tests/fixtures/budgets/serve"
+    for name in ("tiny", "charlm", "gpt2_geom"):
+        target = audit["targets"][name]
+        assert target["predicted_itl_us"] > target["itl_floor_us"] > 0
+        assert target["overfetch_ratio"] >= 1.0
+
+
+def test_serve_audit_summary_missing_budgets_is_none(tmp_path):
+    """A checkout without committed serving budgets must not break
+    emission."""
+    assert bench.serve_audit_summary(
+        None, str(tmp_path / "nowhere")
+    ) is None
+
+
+@pytest.mark.slow
+def test_serve_calibration_ties_prediction_to_measured_record():
+    """The calibration leg: feed serve_audit_summary a measured serve
+    record (the shape serve_summary emits) and it must re-predict the
+    SAME engine config and report the signed error. Slow: one AOT
+    compile of the charlm-geometry programs."""
+    measured = {"itl_ms": {"p50": 2.0}, "ttft_ms": {"p50": 20.0}}
+    out = bench.serve_audit_summary(measured)
+    assert out is not None and "calibration" in out
+    calib = out["calibration"]
+    assert calib["measured_itl_us"] == 2000.0
+    assert calib["predicted_itl_us"] > 0
+    expected = (calib["predicted_itl_us"] - 2000.0) / 2000.0
+    assert calib["itl_calibration_error"] == pytest.approx(
+        expected, abs=1e-3
+    )
+    assert calib["ttft_calibration_error"] is not None
+    # This container benches on CPU: the kind is absent from the peak
+    # table, the prediction prices the reference kind instead.
+    assert calib["priced_for"]
+    assert isinstance(calib["device_matched"], bool)
 
 
 def test_prec_audit_summary_missing_budgets_is_none(tmp_path):

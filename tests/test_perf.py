@@ -79,3 +79,17 @@ def test_ridge_points_are_physical():
     for spec in DEVICE_SPECS.values():
         assert 100 < spec.ridge < 1000
     assert DEVICE_SPECS["TPU v7"].hbm_bw > DEVICE_SPECS["TPU v4"].hbm_bw
+
+
+def test_hbm_capacity_is_physical():
+    # The serving auditor's RKT603 fit check budgets against hbm_bytes:
+    # every entry carries a published per-chip capacity (8 GiB .. 256
+    # GiB), and the known SKU facts hold (v5e 16 GiB, v5p 95 GiB, v7
+    # the largest).
+    for spec in DEVICE_SPECS.values():
+        assert (8 << 30) <= spec.hbm_bytes <= (256 << 30)
+    assert DEVICE_SPECS["TPU v5 lite"].hbm_bytes == 16 << 30
+    assert DEVICE_SPECS["TPU v5"].hbm_bytes == 95 << 30
+    assert DEVICE_SPECS["TPU v7"].hbm_bytes == max(
+        s.hbm_bytes for s in DEVICE_SPECS.values()
+    )
