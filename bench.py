@@ -1119,6 +1119,50 @@ def serve_summary(requests=64, warmup_requests=8):
         return None
 
 
+def resilience_summary(timeout_s=600):
+    """Goodput under an injected worker kill, through the REAL supervised
+    launcher, for BENCH_DETAIL.json (``rocket_tpu.resilience``).
+
+    Runs the resilience smoke's kill leg as a subprocess on the CPU
+    backend (the accelerator stays with the bench parent — a supervised
+    child grabbing the TPU mid-bench would wedge both): a checkpointed
+    MLP run whose rank 0 is SIGKILLed mid-training by the fault plan
+    (``ROCKET_TPU_FAULTS=kill:step=23``); the supervisor must restart it
+    from the latest checkpoint and finish. Records the supervisor.json
+    headline (restarts, goodput_fraction — productive wall-clock over
+    total, crashed generations credited only up to their last durable
+    checkpoint). Best effort: None on any failure — emission must never
+    die on the resilience probe."""
+    try:
+        import subprocess
+        import tempfile
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # TPU/XLA flags from the bench parent don't apply to cpu children.
+        env.pop("XLA_FLAGS", None)
+        smoke = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "resilience_smoke.py",
+        )
+        with tempfile.TemporaryDirectory(prefix="bench_resilience_") as tmp:
+            out_path = os.path.join(tmp, "resilience.json")
+            proc = subprocess.run(
+                [sys.executable, smoke,
+                 "--leg", "kill", "--json-out", out_path],
+                env=env, capture_output=True, text=True, timeout=timeout_s,
+            )
+            if proc.returncode != 0:
+                log("bench: resilience probe failed: "
+                    f"{(proc.stderr or proc.stdout)[-300:]}")
+                return None
+            with open(out_path) as f:
+                return json.load(f)
+    except Exception as exc:  # noqa: BLE001 — best-effort, like the audits
+        log(f"bench: resilience_summary failed: {exc!r}")
+        return None
+
+
 def _carry_calibration(section, prior_section):
     """Merge a committed audit section's calibration entries under the
     freshly-computed ones. A partial bench run only re-predicts the
@@ -1140,7 +1184,8 @@ def _carry_calibration(section, prior_section):
             fresh[key] = val
 
 
-def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
+def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
+                 resilience=None):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
     this file is the complete record it points at.
@@ -1209,6 +1254,13 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None):
         # batching tokens/sec + TTFT/ITL percentiles on the char-LM-sized
         # model, with the compiled-once trace counters alongside.
         detail["serve"] = serve
+    if resilience is not None:
+        # Measured fault tolerance (rocket_tpu.resilience): the supervised
+        # launcher surviving one injected SIGKILL — restart count and
+        # goodput_fraction (productive/total wall-clock, crashed
+        # generations credited to their last durable checkpoint).
+        # Target: goodput_fraction >= 0.5 under a single mid-run kill.
+        detail["resilience"] = resilience
     serve_audit = serve_audit_summary(serve, SERVE_BUDGETS_DIR)
     if serve_audit is not None:
         # Statically-predicted serving latency/HBM (serve_audit budgets)
@@ -1348,13 +1400,23 @@ def main():
         if serve is not None:
             log(f"bench: serve_summary -> {serve}")
 
+    # Supervised-restart goodput probe (rocket_tpu.resilience) — cpu
+    # subprocesses only, same budget discipline as the health/serve probes.
+    resilience = None
+    if time.time() - start <= args.budget_s:
+        log("bench: resilience supervised-restart probe ...")
+        resilience = resilience_summary()
+        if resilience is not None:
+            log(f"bench: resilience_summary -> {resilience}")
+
     # The stdout line is the hard contract and goes out FIRST — a kill or
     # hang during the best-effort detail write must not eat it. It still
     # ends up last in the tail capture because nothing else prints to
     # stdout after it.
     print(format_line(results), flush=True)
     try:
-        write_detail(results, health=health, serve=serve)
+        write_detail(results, health=health, serve=serve,
+                     resilience=resilience)
     except Exception as exc:  # noqa: BLE001 — detail file is best effort
         log(f"bench: could not write {DETAIL_PATH}: {exc!r}")
 

@@ -30,6 +30,7 @@ from rocket_tpu.analysis.rules.jit_rules import (
 )
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
 from rocket_tpu.analysis.rules.race_rules import UnlockedMutationRule
+from rocket_tpu.analysis.rules.retry_rules import SwallowedInterruptRule
 from rocket_tpu.analysis.rules.sched_rules import SCHED_RULES
 from rocket_tpu.analysis.rules.serve_rules import SERVE_RULES
 from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
@@ -48,6 +49,7 @@ AST_RULES = (
     ForkStartMethodRule(),
     StringDtypeRule(),
     UnlockedMutationRule(),
+    SwallowedInterruptRule(),
 )
 
 #: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.

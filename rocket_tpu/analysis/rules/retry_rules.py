@@ -1,0 +1,123 @@
+"""AST rule over exception discipline in retry/supervision loops.
+
+A supervision or retry loop that wraps its body in an over-broad handler
+— bare ``except:``, ``except BaseException:``, or one naming
+``KeyboardInterrupt``/``SystemExit`` — and then falls through to the
+next iteration swallows the two exceptions that MUST terminate it:
+Ctrl-C, and the framework's own :class:`GracefulDrain` (a ``SystemExit``
+subclass carrying the drained exit code). The symptom is exactly the
+failure mode the supervisor exists to prevent: a worker that can neither
+be interrupted nor drained, spinning inside its retry loop until it is
+SIGKILLed with no checkpoint.
+
+Catching ``Exception`` is fine — that is the correct "retry on any
+failure" spelling. A broad handler is also fine when it is *terminal*:
+re-raising (``raise``/``raise e``), ``break``-ing out of the loop, or
+``return``-ing all leave the loop, so nothing is swallowed-and-continued.
+Scope is handlers whose ``try`` sits inside a ``for``/``while`` in the
+same function — a module-level cleanup ``try`` is not a retry loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["SwallowedInterruptRule"]
+
+#: Exception names whose broad catch swallows interrupt/drain exits.
+_BROAD = frozenset({"BaseException", "KeyboardInterrupt", "SystemExit"})
+
+
+def _caught_names(type_node: Optional[ast.AST]) -> Optional[set]:
+    """Dotted-tail names an ``except <type>:`` clause catches; None for a
+    bare ``except:``."""
+    if type_node is None:
+        return None
+    names: set[str] = set()
+    nodes = (
+        list(type_node.elts) if isinstance(type_node, ast.Tuple)
+        else [type_node]
+    )
+    for node in nodes:
+        if isinstance(node, ast.Attribute):  # builtins.BaseException
+            names.add(node.attr)
+        elif isinstance(node, ast.Name):
+            names.add(node.id)
+    return names
+
+
+def _is_terminal(handler: ast.ExceptHandler) -> bool:
+    """True when the handler leaves the loop instead of continuing it: a
+    re-raise, ``break`` or ``return`` in the handler's OWN scope. A
+    nested function's ``return``/``raise`` leaves that function, and a
+    ``break`` inside a loop nested in the handler leaves only that inner
+    loop — neither stops the supervision loop, so neither is terminal
+    (``ast.walk`` would credit both). A ``continue`` is NOT terminal —
+    except-and-continue is the finding."""
+
+    def scan(stmts, in_nested_loop: bool) -> bool:
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # a nested scope's raise/return exits THAT scope
+            if isinstance(stmt, (ast.Raise, ast.Return)):
+                return True
+            if isinstance(stmt, ast.Break):
+                if not in_nested_loop:
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # The loop's else: runs after the loop — a break there
+                # belongs to the SAME level as the loop itself.
+                if scan(stmt.body, True) or scan(stmt.orelse, in_nested_loop):
+                    return True
+                continue
+            for field in ("body", "orelse", "finalbody", "handlers", "cases"):
+                children = getattr(stmt, field, None)
+                if children and scan(children, in_nested_loop):
+                    return True
+        return False
+
+    return scan(handler.body, False)
+
+
+class SwallowedInterruptRule:
+    rule_id = "RKT110"
+    slug = "swallowed-interrupt-in-loop"
+    contract = (
+        "an except handler inside a retry/supervision loop catches "
+        "KeyboardInterrupt/SystemExit (bare except:, BaseException, or "
+        "naming them) without re-raising, breaking or returning — Ctrl-C "
+        "and graceful-drain exits are swallowed and the loop spins on; "
+        "catch Exception instead, or make the handler terminal"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if ctx.enclosing_loop(node) is None:
+                continue
+            for handler in node.handlers:
+                names = _caught_names(handler.type)
+                if names is None:
+                    what = "a bare `except:`"
+                else:
+                    broad = sorted(names & _BROAD)
+                    if not broad:
+                        continue
+                    what = f"`except {', '.join(broad)}`"
+                if _is_terminal(handler):
+                    continue
+                yield Finding(
+                    self.rule_id, ctx.path, handler.lineno,
+                    f"{what} inside a loop swallows KeyboardInterrupt/"
+                    "SystemExit and continues iterating — Ctrl-C and the "
+                    "supervisor's graceful drain (GracefulDrain is a "
+                    "SystemExit) can never stop this loop; catch "
+                    "`Exception`, or re-raise/break/return in the handler",
+                )

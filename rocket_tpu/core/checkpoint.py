@@ -75,6 +75,11 @@ class Checkpointer(Capsule):
 
     def setup(self, attrs: Attributes | None = None) -> None:
         super().setup(attrs)
+        registry = getattr(self._runtime, "checkpointers", None)
+        if registry is not None and self not in registry:
+            # Runtime-wide registry: the drain path reaches this
+            # Checkpointer even from a Looper whose subtree has none.
+            registry.append(self)
         flight = getattr(self._runtime, "flight", None)
         if flight is not None:
             # Register as the black-box bundle's emergency writer: on a
@@ -94,21 +99,25 @@ class Checkpointer(Capsule):
         flag; an explicit path still raises if missing."""
         if path != "latest":
             return path
-        steps = (
-            sorted(
+        # The scan itself is owned by the jax-free resilience module (the
+        # supervisor's progress probe and this resume path must agree on
+        # "newest restorable step" or they silently diverge); only the
+        # per-skip warnings stay local.
+        from rocket_tpu.resilience.supervisor import newest_complete_step
+
+        step = newest_complete_step(self._output_dir)
+        chosen = -1 if step is None else step
+        if os.path.isdir(self._output_dir):
+            for skipped in sorted(
                 (int(d) for d in os.listdir(self._output_dir) if d.isdigit()),
                 reverse=True,
-            )
-            if os.path.isdir(self._output_dir)
-            else []
-        )
-        chosen = -1
-        for step in steps:
-            candidate = os.path.join(self._output_dir, str(step))
-            if self._is_complete(candidate):
-                chosen = step
-                break
-            self.log_warning(f"skipping incomplete checkpoint {candidate}")
+            ):
+                if skipped <= chosen:
+                    break
+                self.log_warning(
+                    "skipping incomplete checkpoint "
+                    f"{os.path.join(self._output_dir, str(skipped))}"
+                )
 
         # Multi-host: every process must restore the SAME step — a stale
         # filesystem view (NFS attribute cache after a fast restart) could
@@ -136,30 +145,12 @@ class Checkpointer(Capsule):
         """A checkpoint is complete when the main process's LAST artifact
         (rng.json) exists AND every shard file referenced by each model's
         chunk index is on disk — a torn async write (preemption mid-save)
-        fails both per-host holes."""
-        if not os.path.exists(os.path.join(candidate, "rng.json")):
-            return False
-        for entry in os.listdir(candidate):
-            model_dir = os.path.join(candidate, entry)
-            if not (entry.startswith("model_") and os.path.isdir(model_dir)):
-                continue
-            index_path = os.path.join(model_dir, "index.json")
-            if not os.path.exists(index_path):
-                return False
-            with open(index_path, "r", encoding="utf-8") as f:
-                index = json.load(f)
-            files = {
-                chunk["file"]
-                for meta in index.values()
-                if meta.get("kind") == "array"
-                for chunk in meta["chunks"]
-            }
-            if any(
-                not os.path.exists(os.path.join(model_dir, name))
-                for name in files
-            ):
-                return False
-        return True
+        fails both per-host holes. The check itself lives in the jax-free
+        ``resilience.supervisor`` module so the supervisor parent process
+        shares ONE definition of "restorable" with the resume path."""
+        from rocket_tpu.resilience.supervisor import is_complete_checkpoint
+
+        return is_complete_checkpoint(candidate)
 
     def launch(self, attrs: Attributes | None = None) -> None:
         self._iter_idx += 1
@@ -248,6 +239,9 @@ class Checkpointer(Capsule):
         """Drain the async writer, then the usual teardown; the trailing
         barrier guarantees every host's shards exist before anyone resumes."""
         if self._runtime is not None:
+            registry = getattr(self._runtime, "checkpointers", None)
+            if registry is not None and self in registry:
+                registry.remove(self)
             flight = getattr(self._runtime, "flight", None)
             if flight is not None:
                 flight.detach_checkpointer(self)
@@ -261,7 +255,7 @@ class Checkpointer(Capsule):
 
     # -- emergency (black-box) save ----------------------------------------
 
-    def save_emergency(self, path: str) -> str:
+    def save_emergency(self, path: str, include_capsules: bool = False) -> str:
         """Synchronous, collective-free state dump into a black-box bundle
         (called by the flight recorder mid-failure, possibly from a
         watchdog thread while other hosts are wedged).
@@ -272,18 +266,77 @@ class Checkpointer(Capsule):
         state is snapshotted (explicit D2H of the addressable shards) and
         written inline. Single-host bundles are directly resumable via
         ``resume_from=<bundle>/checkpoint``; multi-host bundles carry this
-        process's chunks plus the index — forensic state, not a fleet
-        checkpoint. Under a gated anomaly action the state is the
-        last-good (finite) one, since the anomalous update was skipped.
+        process's chunks plus the index — every process calling this into
+        the same directory (the cooperative drain path) yields a complete,
+        resharding-readable checkpoint. Under a gated anomaly action the
+        state is the last-good (finite) one, since the anomalous update
+        was skipped.
+
+        ``include_capsules=True`` (the drain path, where host state is
+        consistent — we are between waves, not mid-crash) also writes
+        ``capsules.pkl`` so epoch/batch indices resume exactly; crash
+        dumps keep the default False.
         """
         runtime = self._runtime
         for k, prepared in enumerate(runtime.models.values()):
             plan = checkpoint_io.snapshot(prepared.state)
             checkpoint_io.write_snapshot(os.path.join(path, f"model_{k}"), plan)
         if runtime.is_main_process:
+            if include_capsules:
+                import pickle
+
+                checkpoint_io.atomic_write(
+                    os.path.join(path, "capsules.pkl"),
+                    pickle.dumps(
+                        [obj.state_dict() for obj in runtime.checkpoint_stack]
+                    ),
+                )
+            # rng.json last: its presence is the completeness marker.
             checkpoint_io.atomic_write(
                 os.path.join(path, "rng.json"),
                 json.dumps(runtime.rng_state_dict()).encode("utf-8"),
+            )
+        return path
+
+    # -- drain (cooperative preemption) save -------------------------------
+
+    def save_drain(self) -> str:
+        """Preemption-drain checkpoint: synchronous, barrier-free, written
+        into the regular numbered step layout so a restarted run's
+        ``resume_from="latest"`` finds it with no extra plumbing.
+
+        Called by the Looper at a wave boundary after a drain request
+        (SIGTERM). Every process writes its own shards concurrently; the
+        supervisor waits for all workers to exit before restarting, so
+        the checkpoint is complete by resume time. If the cooperating
+        processes happened to drain at different wave indices (signal
+        skew), the torn directories fail ``_is_complete`` and resume
+        falls back to the last periodic checkpoint — never a corrupt
+        restore. A step already covered by a complete periodic save is
+        not rewritten — but the ``drain.json`` marker is written either
+        way (the drain boundary can coincide with a periodic save step,
+        and the marker is the record that a drain happened there)."""
+        import time
+
+        step = self._iter_idx
+        path = os.path.join(self._output_dir, str(step))
+        # Don't interleave with an in-flight periodic save's file writes.
+        self._writer.wait()
+        # Record the step BEFORE snapshotting capsule states (the
+        # _save_sync idiom): the pickled saved_steps must include this
+        # drain checkpoint, or a resumed run's keep_last rotation never
+        # learns about it and the directory leaks forever.
+        if step not in self._saved_steps:
+            self._saved_steps.append(step)
+        if not self._is_complete(path):
+            self.save_emergency(path, include_capsules=True)
+            self.log_info(f"drain checkpoint written at {path}")
+        if self._runtime.is_main_process:
+            checkpoint_io.atomic_write(
+                os.path.join(path, "drain.json"),
+                json.dumps(
+                    {"reason": "drain", "step": step, "unix": time.time()}
+                ).encode("utf-8"),
             )
         return path
 

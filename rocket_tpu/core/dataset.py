@@ -258,6 +258,12 @@ class Dataset(Capsule):
             return
 
         data = batch.data
+        # Fault injection (rocket_tpu.resilience): a scheduled poison fault
+        # NaN-fills THIS batch before placement, so the health sentinels'
+        # anomaly policy is exercised through the real data path.
+        faults = getattr(self._runtime, "faults", None)
+        if faults is not None:
+            data = faults.poison_hook(data)
         if self._device_placement and not self._device_resident:
             with telemetry.span("data/h2d", cat="data_wait"):
                 data = self._runtime.shard_batch(data)  # dataset.py:111-118

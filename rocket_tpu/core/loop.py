@@ -162,10 +162,21 @@ class Looper(Dispatcher):
         # All of it is host bookkeeping — nothing touches the device.
         telemetry = getattr(self._runtime, "telemetry", None)
         obs_on = telemetry is not None and telemetry.enabled
+        # Resilience (rocket_tpu.resilience): the drain flag is polled at
+        # every wave boundary — a SIGTERM lands mid-wave, the wave
+        # finishes, and the NEXT boundary checkpoints + exits with the
+        # drained code; the fault injector (ROCKET_TPU_FAULTS) fires its
+        # scheduled kills/wedges here so the real loop path is what dies.
+        drain = getattr(self._runtime, "drain", None)
+        faults = getattr(self._runtime, "faults", None)
         if obs_on:
             telemetry.watchdog_arm()
         try:
             for it in range(start, self._repeats):
+                if drain is not None and drain.requested:
+                    self._drain_exit()
+                if faults is not None:
+                    faults.step_hook(self._tag, self._batch_idx)
                 attrs.batch = None
                 attrs.mode = self.mode
                 # Strict mode clamps the iteration wave — the steady-state
@@ -253,6 +264,43 @@ class Looper(Dispatcher):
             attrs.looper = None
 
     # -- helpers -----------------------------------------------------------
+
+    def _drain_exit(self) -> None:
+        """Honor a drain request at a wave boundary: write a synchronous
+        drain checkpoint through the first Checkpointer in this phase and
+        raise :class:`~rocket_tpu.resilience.faults.GracefulDrain` — a
+        ``SystemExit`` carrying the distinguished drained exit code, so
+        the process unwinds through every ``finally`` (bar close, watchdog
+        disarm, Launcher destroy, telemetry flush) and the supervisor sees
+        a clean stop. The crash-forensics ``except Exception`` below does
+        not catch it: a drain is not a failure."""
+        from rocket_tpu.core.checkpoint import Checkpointer
+        from rocket_tpu.resilience.faults import GracefulDrain
+
+        reason = self._runtime.drain.reason or "drain"
+        self.log_info(
+            f"drain requested ({reason}) — checkpointing and exiting "
+            f"[{self._tag}, batch {self._batch_idx}]"
+        )
+        path = None
+        # Prefer this phase's own Checkpointer (its step index matches the
+        # waves being drained); fall back to the runtime-wide registry so
+        # a SIGTERM landing during a checkpointer-less phase (eval) still
+        # saves through the sibling train phase's Checkpointer.
+        checkpointers = self.find(Checkpointer) or [
+            c for c in getattr(self._runtime, "checkpointers", ())
+        ]
+        if checkpointers:
+            path = checkpointers[0].save_drain()
+        else:
+            self.log_warning(
+                "drain: no Checkpointer in this run — exiting without an "
+                "emergency checkpoint"
+            )
+        telemetry = getattr(self._runtime, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            telemetry.registry.counter("resilience/drains").inc()
+        raise GracefulDrain(checkpoint=path, reason=reason)
 
     def _iteration_guard(self, warmup: bool = False):
         """Transfer guard for one iteration wave (strict mode), else a

@@ -5,7 +5,10 @@ key registry metrics. Given a Chrome-trace span file instead, it
 validates the file and reconstructs per-category inclusive totals from
 the span events. A telemetry.json from a zero-step run renders an
 explicit "no steps recorded" row (never a crash on the degenerate
-record).
+record). Given a ``supervisor.json`` (a supervised launch's state file)
+it renders the per-generation table + goodput-under-failures headline;
+a supervisor.json sitting next to the telemetry record is folded into
+the same report.
 
 ``blackbox`` renders a flight-recorder forensic bundle
 (``runs/<project>/blackbox/<reason>/``, or its ``blackbox.json``
@@ -75,6 +78,32 @@ def _report_telemetry(doc: dict) -> str:
         lines.append(
             f"spans: {spans.get('events', 0)} events "
             f"({spans.get('dropped', 0)} dropped) in {spans.get('file')}"
+        )
+    return "\n".join(lines)
+
+
+def _render_supervisor(doc: dict) -> str:
+    """The supervisor section: one line per generation plus the headline
+    goodput under failures (supervisor.json, written by
+    ``python -m rocket_tpu.launch --supervise``)."""
+    lines = [
+        f"supervisor: outcome={doc.get('outcome')} "
+        f"restarts={doc.get('restarts', 0)} "
+        f"drain_events={doc.get('drain_events', 0)} "
+        f"goodput_fraction={_fmt(doc.get('goodput_fraction'))} "
+        f"(productive {_fmt(doc.get('productive_wall_s'))}s of "
+        f"{_fmt(doc.get('total_wall_s'))}s)",
+        f"  {'gen':>4} {'nproc':>5} {'outcome':<10} {'duration_s':>10} "
+        f"{'productive_s':>12} {'rc':>5} {'ckpt_step':>9}",
+    ]
+    for gen in doc.get("generations", []):
+        lines.append(
+            f"  {gen.get('gen', '?'):>4} {gen.get('nproc', '?'):>5} "
+            f"{gen.get('outcome', '?'):<10} "
+            f"{_fmt(gen.get('duration_s')):>10} "
+            f"{_fmt(gen.get('productive_s')):>12} "
+            f"{str(gen.get('rc')):>5} "
+            f"{str(gen.get('ckpt_step')):>9}"
         )
     return "\n".join(lines)
 
@@ -263,8 +292,28 @@ def main(argv=None) -> int:
         print(f"error: cannot read {path}: {exc}", file=sys.stderr)
         return 2
 
+    if isinstance(doc, dict) and "generations" in doc and "goodput" not in doc:
+        # A supervisor.json (python -m rocket_tpu.launch --supervise).
+        print(_render_supervisor(doc))
+        return 0
     if isinstance(doc, dict) and "goodput" in doc:
-        print(_report_telemetry(doc))
+        out = _report_telemetry(doc)
+        # A supervised run leaves supervisor.json next to (or above) the
+        # telemetry record; fold its section into the same report.
+        here = os.path.dirname(os.path.abspath(path))
+        for candidate in (
+            os.path.join(here, "supervisor.json"),
+            os.path.join(os.path.dirname(here), "supervisor.json"),
+        ):
+            if os.path.exists(candidate):
+                try:
+                    with open(candidate, "r", encoding="utf-8") as f:
+                        sup = json.load(f)
+                    out += "\n\n" + _render_supervisor(sup)
+                except (OSError, json.JSONDecodeError):
+                    pass  # the telemetry report still stands alone
+                break
+        print(out)
         return 0
     try:
         events = load_chrome_trace(path)

@@ -113,6 +113,12 @@ class Telemetry:
         #: are enabled. None otherwise — every use below is guarded.
         self.flight = None
         self.health = None
+        #: Runtime-wired (rocket_tpu.resilience): when a supervisor owns
+        #: this process, watchdog ESCALATION (a genuinely wedged step, not
+        #: one slow wave) exits with this code after the forensic dump so
+        #: the supervisor restarts the worker instead of watching it hang.
+        #: None (default) keeps escalation diagnostic-only.
+        self.escalation_exit_code: Optional[int] = None
         self.watchdog: Optional[Watchdog] = None
         if self.enabled and watchdog_secs is not None:
             self.watchdog = Watchdog(
@@ -226,6 +232,19 @@ class Telemetry:
         box even if it is later SIGKILLed."""
         if self.flight is not None:
             self.flight.dump("watchdog_stall", extra={"report": report})
+        if self.escalation_exit_code is not None:
+            # The wedged main thread cannot be unwound from this watchdog
+            # thread (it is blocked inside a C call); with the black box
+            # written (main-process-gated, just above), the only honest
+            # recovery is a restartable exit — os._exit skips every
+            # finally on purpose, a wedged process cannot run teardown.
+            if self._logger is not None:
+                self._logger.error(
+                    "watchdog escalation under supervision: exiting with "
+                    "code %d so the supervisor restarts this worker",
+                    self.escalation_exit_code,
+                )
+            os._exit(self.escalation_exit_code)
 
     def exception_dump(self, exc: BaseException, **context) -> None:
         """Forensic bundle for an exception escaping the step loop

@@ -1,0 +1,55 @@
+"""rocket_tpu.resilience — elastic supervision, drain, fault injection.
+
+The reflexes on top of the obs stack's senses (ROADMAP item 5): a
+supervising launcher that restarts crashed generations from the last good
+checkpoint (``supervisor.py``), a cooperative SIGTERM drain protocol the
+Looper honors at wave boundaries (``faults.DrainState`` /
+``GracefulDrain``), and a deterministic fault-injection harness
+(``faults.FaultPlan``) that exercises the real launcher/Looper/
+Checkpointer path under worker loss. See docs/distributed.md
+"Surviving failures".
+"""
+
+from rocket_tpu.resilience.faults import (
+    DRAIN_ENV,
+    EXIT_DRAINED,
+    EXIT_WEDGED,
+    FAULTS_ENV,
+    GENERATION_ENV,
+    SUPERVISED_ENV,
+    DrainState,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    GracefulDrain,
+    install_signal_drain,
+)
+from rocket_tpu.resilience.supervisor import (
+    SUPERVISOR_FILE,
+    GenerationRecord,
+    RestartPolicy,
+    Supervisor,
+    is_complete_checkpoint,
+    newest_complete_step,
+)
+
+__all__ = [
+    "DRAIN_ENV",
+    "EXIT_DRAINED",
+    "EXIT_WEDGED",
+    "FAULTS_ENV",
+    "GENERATION_ENV",
+    "SUPERVISED_ENV",
+    "SUPERVISOR_FILE",
+    "DrainState",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "GenerationRecord",
+    "GracefulDrain",
+    "RestartPolicy",
+    "Supervisor",
+    "install_signal_drain",
+    "is_complete_checkpoint",
+    "newest_complete_step",
+]

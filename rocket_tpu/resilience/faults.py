@@ -1,0 +1,466 @@
+"""Deterministic fault injection + graceful-drain plumbing.
+
+The supervisor (``rocket_tpu.resilience.supervisor``) treats worker death
+as an event; this module provides the two worker-side halves it needs:
+
+* **FaultPlan / FaultInjector** — a deterministic, seedable schedule of
+  injected failures (kill a rank at a step, SIGTERM at a wall time, wedge
+  a step, poison a batch) delivered through the ``ROCKET_TPU_FAULTS`` env
+  var, so the *real* launcher / Looper / Checkpointer path gets exercised
+  under failure — not a mock. Faults are scoped to a supervisor
+  *generation* (``gen=`` key, default 0, matched against
+  ``ROCKET_TPU_GENERATION``) so a restarted generation runs clean instead
+  of being re-killed forever.
+* **DrainState / GracefulDrain** — the cooperative preemption protocol.
+  A SIGTERM (forwarded by the launcher/supervisor, or a scheduled-
+  preemption notice) sets the runtime's :class:`DrainState`; the Looper
+  polls it at every wave boundary, finishes the in-flight wave, writes a
+  synchronous emergency checkpoint (``Checkpointer.save_drain``) and
+  raises :class:`GracefulDrain` — a ``SystemExit`` subclass carrying
+  :data:`EXIT_DRAINED`, so the process exits with the distinguished
+  "drained" code through the normal teardown path (telemetry flushed,
+  async writers drained) without any user-code changes.
+
+Everything here is stdlib-only (numpy imported lazily inside the poison
+path) so the supervisor parent process can import it without paying for
+jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "EXIT_DRAINED",
+    "EXIT_WEDGED",
+    "Fault",
+    "FaultPlan",
+    "FaultInjector",
+    "DrainState",
+    "GracefulDrain",
+    "install_signal_drain",
+    "env_truthy",
+]
+
+#: Exit code of a worker that finished a cooperative drain (in-flight wave
+#: completed + emergency checkpoint written). The supervisor honors it as
+#: a CLEAN stop, not a crash. 84 deliberately avoids the shell's 126/127,
+#: Python's 1/2, and the 128+signum band.
+EXIT_DRAINED = 84
+
+#: Exit code of a worker whose watchdog escalated a wedged step under a
+#: supervisor: the flight recorder has dumped its black box and the only
+#: honest recovery is a restart (the wedged main thread cannot unwind).
+EXIT_WEDGED = 85
+
+#: Env vars forming the supervisor<->worker contract.
+FAULTS_ENV = "ROCKET_TPU_FAULTS"
+GENERATION_ENV = "ROCKET_TPU_GENERATION"
+SUPERVISED_ENV = "ROCKET_TPU_SUPERVISED"
+DRAIN_ENV = "ROCKET_TPU_DRAIN"
+
+_KINDS = ("kill", "sigterm", "wedge", "poison")
+
+
+def env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault.
+
+    ``step`` counts iteration waves driven by THIS process since the
+    injector was installed (Looper waves across epochs/phases — the
+    injector keeps its own monotonic counter, so a mid-epoch resume in a
+    later generation does not replay generation-0 step numbers).
+    ``wall`` (sigterm only) is seconds after install. ``rank=None``
+    matches every process; ``gen`` scopes the fault to one supervisor
+    generation (default 0 — a restarted run is not re-killed).
+    """
+
+    kind: str
+    step: Optional[int] = None
+    wall: Optional[float] = None
+    rank: Optional[int] = None
+    gen: int = 0
+    secs: float = 3600.0  # wedge duration
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"FaultPlan: unknown fault kind {self.kind!r} "
+                f"(expected one of {_KINDS})"
+            )
+        if self.kind == "sigterm":
+            if self.step is None and self.wall is None:
+                raise ValueError(
+                    "FaultPlan: sigterm fault needs step= or wall="
+                )
+        elif self.step is None:
+            raise ValueError(f"FaultPlan: {self.kind} fault needs step=")
+
+    def to_spec(self) -> str:
+        parts = []
+        for key in ("step", "wall", "rank", "secs"):
+            value = getattr(self, key)
+            if value is None:
+                continue
+            if key == "secs" and self.kind != "wedge":
+                continue
+            parts.append(f"{key}={value:g}" if isinstance(value, float)
+                         else f"{key}={value}")
+        parts.append(f"gen={self.gen}")
+        return f"{self.kind}:" + ",".join(parts)
+
+
+class FaultPlan:
+    """An ordered set of :class:`Fault` entries with a text wire format.
+
+    Spec grammar (the ``ROCKET_TPU_FAULTS`` value)::
+
+        kill:step=23;sigterm:wall=3.5;wedge:step=7,secs=600;poison:step=3,rank=1,gen=1
+
+    Entries are ``;``-separated; each is ``kind:key=value,...``. Parsing
+    is strict — a typoed kind or key raises rather than silently injecting
+    nothing (a fault plan that doesn't fire reads as a passing test).
+    """
+
+    def __init__(self, faults: list[Fault]) -> None:
+        self.faults = list(faults)
+
+    def __iter__(self):
+        return iter(self.faults)
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    def to_spec(self) -> str:
+        return ";".join(f.to_spec() for f in self.faults)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, _, rest = entry.partition(":")
+            kind = kind.strip()
+            kwargs: dict = {}
+            for item in rest.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                key, sep, value = item.partition("=")
+                key = key.strip()
+                if not sep:
+                    raise ValueError(
+                        f"FaultPlan: malformed item {item!r} in {entry!r} "
+                        "(expected key=value)"
+                    )
+                if key in ("step", "rank", "gen"):
+                    kwargs[key] = int(value)
+                elif key in ("wall", "secs"):
+                    kwargs[key] = float(value)
+                else:
+                    raise ValueError(
+                        f"FaultPlan: unknown key {key!r} in {entry!r}"
+                    )
+            faults.append(Fault(kind=kind, **kwargs))
+        return cls(faults)
+
+    @classmethod
+    def sample(cls, seed: int, max_step: int = 50, nproc: int = 1,
+               kinds: tuple = ("kill", "sigterm", "wedge", "poison"),
+               n: int = 1) -> "FaultPlan":
+        """A deterministic random plan — same (seed, args) => same plan.
+
+        The chaos-testing entry point: a CI matrix can sweep seeds and
+        every failing seed reproduces exactly.
+        """
+        rng = random.Random(seed)
+        faults = []
+        for _ in range(n):
+            kind = rng.choice(list(kinds))
+            step = rng.randrange(1, max_step)
+            rank = rng.randrange(nproc) if nproc > 1 else None
+            faults.append(Fault(kind=kind, step=step, rank=rank))
+        return cls(faults)
+
+
+class FaultInjector:
+    """Executes a :class:`FaultPlan` inside a worker process.
+
+    The Looper calls :meth:`step_hook` at the top of every iteration wave
+    and the Dataset routes each consumed batch through :meth:`poison_hook`
+    — both are one attribute check when no injector is armed (the common
+    case: ``runtime.faults is None``).
+
+    Action functions are injectable for tests; the defaults are the real
+    thing (``SIGKILL``/``SIGTERM`` to self, ``time.sleep`` wedge).
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        process_index: int = 0,
+        generation: int = 0,
+        logger=None,
+        kill_fn=None,
+        sigterm_fn=None,
+        sleep_fn=time.sleep,
+    ) -> None:
+        self._logger = logger
+        self._kill = kill_fn or (lambda: os.kill(os.getpid(), signal.SIGKILL))
+        self._sigterm = sigterm_fn or (
+            lambda: os.kill(os.getpid(), signal.SIGTERM)
+        )
+        self._sleep = sleep_fn
+        self.generation = generation
+        self.process_index = process_index
+        self.active = [
+            f for f in plan
+            if f.gen == generation
+            and (f.rank is None or f.rank == process_index)
+        ]
+        self._waves = 0
+        self._batches = 0
+        self._fired: list[str] = []
+        self._timers: list[threading.Timer] = []
+
+    @classmethod
+    def from_env(cls, process_index: int = 0, logger=None,
+                 environ=None) -> Optional["FaultInjector"]:
+        """Build from ``ROCKET_TPU_FAULTS`` / ``ROCKET_TPU_GENERATION``;
+        None when no plan is set (the zero-cost default)."""
+        environ = os.environ if environ is None else environ
+        spec = environ.get(FAULTS_ENV, "").strip()
+        if not spec:
+            return None
+        generation = int(environ.get(GENERATION_ENV, "0") or 0)
+        return cls(FaultPlan.parse(spec), process_index=process_index,
+                   generation=generation, logger=logger)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> None:
+        """Arm wall-clock faults (daemon timers for ``sigterm:wall=``)."""
+        for fault in self.active:
+            if fault.kind == "sigterm" and fault.wall is not None:
+                timer = threading.Timer(
+                    fault.wall, self._fire, args=(fault, "wall")
+                )
+                timer.daemon = True
+                timer.start()
+                self._timers.append(timer)
+
+    # -- hooks -------------------------------------------------------------
+
+    def step_hook(self, tag: str, batch_idx: int) -> None:
+        """Called by the Looper at the top of each iteration wave."""
+        self._waves += 1
+        for fault in self.active:
+            if fault.kind in ("kill", "wedge") or (
+                fault.kind == "sigterm" and fault.wall is None
+            ):
+                if fault.step == self._waves:
+                    self._fire(fault, f"{tag}[{batch_idx}]")
+
+    def poison_hook(self, batch):
+        """Called by the Dataset for every consumed batch; NaN-poisons the
+        inexact leaves of the scheduled one (exercising the health
+        sentinels' anomaly policy through the real data path). A batch
+        with nothing poisonable (a fused device-gather marker) is passed
+        through UNFIRED with a loud warning — a fault plan that silently
+        no-ops would read as a vacuously passing test, the exact failure
+        mode the strict spec parser exists to prevent."""
+        self._batches += 1
+        for fault in self.active:
+            if fault.kind == "poison" and fault.step == self._batches:
+                poisoned, count = _poison_tree(batch)
+                if count == 0:
+                    self._warn(
+                        f"fault injection: poison fault {fault.to_spec()} "
+                        f"matched batch[{self._batches}] but found no "
+                        "poisonable array leaves (fused device-gather "
+                        "marker batch?) — NOT firing; run the dataset "
+                        "with device_cache=False / fuse_gather=False to "
+                        "exercise the poison path"
+                    )
+                    return batch
+                self._note(fault, f"batch[{self._batches}]")
+                return poisoned
+        return batch
+
+    @property
+    def fired(self) -> tuple:
+        return tuple(self._fired)
+
+    # -- actions -----------------------------------------------------------
+
+    def _note(self, fault: Fault, where: str) -> None:
+        self._fired.append(f"{fault.kind}@{where}")
+        self._warn(
+            f"fault injection: firing {fault.to_spec()} at {where} "
+            f"(gen {self.generation}, rank {self.process_index})"
+        )
+
+    def _warn(self, message: str) -> None:
+        if self._logger is not None:
+            self._logger.warning("%s", message)
+        else:  # pragma: no cover - no logger wired
+            print(message, file=sys.stderr, flush=True)
+
+    def _fire(self, fault: Fault, where: str) -> None:
+        self._note(fault, where)
+        if fault.kind == "kill":
+            self._kill()
+        elif fault.kind == "sigterm":
+            self._sigterm()
+        elif fault.kind == "wedge":
+            # Block the step loop without exiting: no heartbeat reaches
+            # the watchdog, whose escalation path (obs/telemetry.py) turns
+            # the wedge into an EXIT_WEDGED restart under a supervisor.
+            self._sleep(fault.secs)
+
+
+def _poison_tree(batch):
+    """NaN-fill every inexact array leaf of a batch pytree.
+
+    Returns ``(poisoned, count)`` where ``count`` is the number of leaves
+    actually poisoned — the caller must not record the fault as fired when
+    nothing was touched. Leaves are matched by duck-typed ``dtype``/
+    ``shape`` so device-resident batches (jax Arrays from a
+    ``DeviceCachedLoader``) poison too, replaced by host NaN arrays the
+    step places like any other input. Fused gather/slice MARKER batches
+    (``{"_device_gather": ...}``) are left whole: their ``cache`` leaf is
+    the entire dataset shared across steps, and NaN-filling it would
+    poison every subsequent batch, not the scheduled one.
+    """
+    import numpy as np
+
+    def poison(leaf):
+        dtype = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if (
+            dtype is not None
+            and shape is not None
+            and np.issubdtype(dtype, np.inexact)
+        ):
+            return np.full(shape, np.nan, dtype=dtype), 1
+        return leaf, 0
+
+    # Host-side structure walk: batches at this point are nested
+    # dict/list/array (pre-placement), so a tiny manual map avoids a
+    # jax import in the supervisor-importable module.
+    if isinstance(batch, dict):
+        if "_device_gather" in batch or "_device_slice" in batch:
+            return batch, 0
+        out, total = {}, 0
+        for k, v in batch.items():
+            out[k], n = _poison_tree(v)
+            total += n
+        return out, total
+    if isinstance(batch, (list, tuple)):
+        parts = [_poison_tree(v) for v in batch]
+        return type(batch)(p for p, _ in parts), sum(n for _, n in parts)
+    return poison(batch)
+
+
+class GracefulDrain(SystemExit):
+    """Raised by the Looper when a drain request has been honored.
+
+    A ``SystemExit`` subclass so the process unwinds through every
+    ``finally`` (Launcher destroy, telemetry flush, checkpoint-writer
+    drain) and exits with :data:`EXIT_DRAINED` without any user-script
+    cooperation; the Looper's crash-forensics handler (``except
+    Exception``) deliberately does not catch it — a drain is not a
+    failure.
+    """
+
+    def __init__(self, checkpoint: Optional[str] = None,
+                 reason: str = "drain") -> None:
+        super().__init__(EXIT_DRAINED)
+        self.checkpoint = checkpoint
+        self.reason = reason
+
+
+class DrainState:
+    """The runtime's drain flag: set by the SIGTERM handler (or
+    programmatically, e.g. a cloud preemption-notice poller), polled by
+    every Looper at wave boundaries. Plain attribute reads/writes — both
+    sides are Python-atomic and the flag only ever goes False->True."""
+
+    def __init__(self) -> None:
+        self.requested = False
+        self.reason: Optional[str] = None
+        self.requested_at: Optional[float] = None
+
+    def request(self, reason: str = "drain") -> None:
+        if not self.requested:
+            self.requested = True
+            self.reason = reason
+            self.requested_at = time.time()
+
+
+def install_signal_drain(drain: DrainState, logger=None) -> bool:
+    """Route SIGTERM into ``drain.request()``; returns False when not
+    installable (non-main thread, or a platform without signals).
+
+    Chains any previously-installed Python-level handler so embedding
+    apps keep their own notification; the default/ignore dispositions are
+    replaced (that replacement IS the feature).
+
+    SIGINT is routed too: an interactive Ctrl-C reaches the whole
+    foreground process group, so without this a supervised worker dies
+    with a KeyboardInterrupt while its supervisor is busy orchestrating
+    the graceful drain the user asked for. The first Ctrl-C requests a
+    drain and RESTORES the previous SIGINT disposition — a second Ctrl-C
+    interrupts hard, the terminal contract."""
+    if threading.current_thread() is not threading.main_thread():
+        if logger is not None:
+            logger.warning(
+                "drain: not installing SIGTERM handler off the main thread"
+            )
+        return False
+    try:
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            drain.request("SIGTERM")
+            if logger is not None:
+                logger.warning(
+                    "SIGTERM received — draining at the next wave boundary"
+                )
+            if callable(previous) and previous not in (
+                signal.SIG_IGN, signal.SIG_DFL, signal.default_int_handler,
+            ):
+                previous(signum, frame)
+
+        signal.signal(signal.SIGTERM, handler)
+
+        previous_int = signal.getsignal(signal.SIGINT)
+
+        def int_handler(signum, frame):
+            drain.request("SIGINT")
+            if logger is not None:
+                logger.warning(
+                    "SIGINT received — draining at the next wave boundary "
+                    "(press again to interrupt hard)"
+                )
+            signal.signal(signal.SIGINT, previous_int)
+
+        signal.signal(signal.SIGINT, int_handler)
+        return True
+    except (ValueError, OSError) as exc:  # non-main interpreter, exotic OS
+        if logger is not None:
+            logger.warning("drain: cannot install SIGTERM handler: %r", exc)
+        return False

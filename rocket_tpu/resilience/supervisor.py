@@ -1,0 +1,538 @@
+"""Elastic supervisor — the restarting, draining side of ``rocket_tpu.launch``.
+
+``python -m rocket_tpu.launch --supervise -n N train.py`` wraps the plain
+multi-process launcher in a supervision loop that treats any worker exit
+as an *event*, not a verdict:
+
+* a **crash** (non-zero exit, signal kill, injected fault) reaps the
+  whole generation, waits out a capped exponential backoff, re-resolves
+  the topology (after ``degrade_after`` consecutive no-progress failures
+  the worker count shrinks toward ``min_procs`` — the "surviving mesh"),
+  and spawns the next generation; the training script resumes from the
+  last good checkpoint via ``Checkpointer(resume_from="latest")`` and the
+  resharding reader restores across process counts;
+* a **drain** (SIGTERM to the supervisor, forwarded to the workers; the
+  workers finish the in-flight wave, checkpoint, and exit
+  :data:`~rocket_tpu.resilience.faults.EXIT_DRAINED`) is honored as a
+  clean stop — exit 0;
+* a **crash loop** (``crash_loop_threshold`` consecutive generations
+  that made no progress) or an exhausted ``max_restarts`` budget refuses
+  to thrash: the supervisor records the failing generation's output tail
+  in ``supervisor.json`` (its black box) and exits non-zero.
+
+Progress is observed from the outside, via the checkpoint directory: the
+newest *complete* step advancing during a generation both resets the
+crash-loop counter and timestamps the salvage point for goodput
+accounting. ``supervisor.json`` (written atomically after every
+generation, so a killed supervisor still leaves its trail) carries the
+per-generation record and the headline ``goodput_fraction`` =
+productive wall-clock / total wall-clock, where a crashed generation is
+productive only up to its last observed checkpoint advance — work that
+survived the crash.
+
+The supervisor's own logic is stdlib-only and never touches a jax API —
+no device initialization, no compilation — so the parent stays
+signal-safe and cheap to restart. (Reaching it through the
+``rocket_tpu`` package root still pays the package's eager jax *import*;
+the backend itself is initialized lazily and only in the workers.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from rocket_tpu.resilience.faults import (
+    EXIT_DRAINED,
+    EXIT_WEDGED,
+    GENERATION_ENV,
+    SUPERVISED_ENV,
+)
+
+__all__ = [
+    "RestartPolicy",
+    "GenerationRecord",
+    "Supervisor",
+    "SUPERVISOR_FILE",
+    "is_complete_checkpoint",
+    "newest_complete_step",
+]
+
+SUPERVISOR_FILE = "supervisor.json"
+
+#: Env var the supervisor sets to the cumulative restart count.
+RESTARTS_ENV = "ROCKET_TPU_RESTARTS"
+
+
+# -- checkpoint-completeness scan (stdlib; shared with core/checkpoint) ------
+
+
+def is_complete_checkpoint(candidate: str) -> bool:
+    """A checkpoint directory is complete when the main process's LAST
+    artifact (rng.json) exists AND every shard file referenced by each
+    model's chunk index is on disk — a torn write (preemption mid-save, a
+    crash between two ranks' drain saves) fails one of the two."""
+    if not os.path.exists(os.path.join(candidate, "rng.json")):
+        return False
+    try:
+        entries = os.listdir(candidate)
+    except OSError:
+        return False
+    for entry in entries:
+        model_dir = os.path.join(candidate, entry)
+        if not (entry.startswith("model_") and os.path.isdir(model_dir)):
+            continue
+        index_path = os.path.join(model_dir, "index.json")
+        if not os.path.exists(index_path):
+            return False
+        try:
+            with open(index_path, "r", encoding="utf-8") as f:
+                index = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        files = {
+            chunk["file"]
+            for meta in index.values()
+            if meta.get("kind") == "array"
+            for chunk in meta["chunks"]
+        }
+        if any(
+            not os.path.exists(os.path.join(model_dir, name))
+            for name in files
+        ):
+            return False
+    return True
+
+
+def newest_complete_step(output_dir: Optional[str]) -> Optional[int]:
+    """Newest step directory under ``output_dir`` passing
+    :func:`is_complete_checkpoint` (this host's filesystem view only; the
+    Checkpointer's resume path adds the multi-host broadcast on top)."""
+    if not output_dir or not os.path.isdir(output_dir):
+        return None
+    steps = sorted(
+        (int(d) for d in os.listdir(output_dir) if d.isdigit()), reverse=True
+    )
+    for step in steps:
+        if is_complete_checkpoint(os.path.join(output_dir, str(step))):
+            return step
+    return None
+
+
+# -- policy ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Knobs of the supervision loop (CLI flags map 1:1 onto these)."""
+
+    #: Total restart budget across the whole run; exhausted -> give up.
+    max_restarts: int = 16
+    #: Capped exponential backoff between generations.
+    backoff_base_s: float = 0.5
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    #: Consecutive NO-PROGRESS failed generations before refusing to thrash.
+    crash_loop_threshold: int = 3
+    #: Topology re-resolution: after this many consecutive no-progress
+    #: failures at one worker count, retry with one fewer process...
+    degrade_after: int = 2
+    #: ...but never below this floor.
+    min_procs: int = 1
+    #: A generation surviving at least this long counts as progress even
+    #: without a checkpoint advance (covers scripts that do not
+    #: checkpoint). Only consulted when no ``ckpt_dir`` probe is
+    #: configured — with a probe, durable checkpoint advance is the sole
+    #: progress evidence, so a deterministic crasher whose startup
+    #: outlives the grace cannot evade the crash-loop detector.
+    progress_grace_s: float = 5.0
+
+    def backoff_s(self, consecutive_failures: int) -> float:
+        n = max(1, consecutive_failures)
+        return min(
+            self.backoff_max_s,
+            self.backoff_base_s * self.backoff_factor ** (n - 1),
+        )
+
+
+@dataclasses.dataclass
+class GenerationRecord:
+    gen: int
+    nproc: int
+    started_unix: float
+    duration_s: float = 0.0
+    productive_s: float = 0.0
+    rc: Optional[int] = None
+    exit_codes: list = dataclasses.field(default_factory=list)
+    outcome: str = "running"
+    progressed: bool = False
+    coord_error: bool = False
+    ckpt_step: Optional[int] = None
+    backoff_s: float = 0.0
+    output_tail: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _classify(rc: int) -> str:
+    if rc == 0:
+        return "completed"
+    if rc == EXIT_DRAINED:
+        return "drained"
+    if rc == EXIT_WEDGED:
+        return "wedged"
+    return "crashed"
+
+
+# -- the supervisor ----------------------------------------------------------
+
+
+class Supervisor:
+    """One supervised run = a sequence of worker generations.
+
+    Parameters
+    ----------
+    nproc:
+        Initial worker count per generation.
+    script, script_args:
+        The training script (resumes itself via
+        ``Checkpointer(resume_from="latest")``).
+    policy:
+        :class:`RestartPolicy`; default knobs suit CI-scale runs.
+    state_dir:
+        Where ``supervisor.json`` lands (atomically, after every
+        generation).
+    ckpt_dir:
+        The training script's checkpoint ``output_dir`` — the progress
+        probe. When set, durable checkpoint advance is the ONLY progress
+        evidence the crash-loop/degrade counters accept. Optional;
+        without it progress falls back to the ``progress_grace_s``
+        duration heuristic and crashed generations salvage nothing in
+        the goodput accounting.
+    run_generation:
+        Injectable generation runner ``(gen, nproc, drain_event,
+        on_poll) -> (rc, exit_codes, output_tail[, coord_error])`` —
+        unit tests script failures without spawning processes; the
+        default drives :class:`rocket_tpu.launch.WorkerGroup`. The
+        optional fourth element marks a coordinator bind/connect
+        failure (see :attr:`WorkerGroup.coord_error`): an
+        infrastructure fault, not the workload's.
+    """
+
+    def __init__(
+        self,
+        nproc: int,
+        script: str,
+        script_args: Optional[list] = None,
+        policy: Optional[RestartPolicy] = None,
+        state_dir: str = os.path.join("runs", "supervised"),
+        ckpt_dir: Optional[str] = None,
+        coordinator_port: Optional[int] = None,
+        term_grace_s: float = 10.0,
+        drain_grace_s: float = 60.0,
+        extra_env: Optional[dict] = None,
+        run_generation: Optional[Callable] = None,
+        sleep: Callable[[float], None] = None,
+        clock: Callable[[], float] = time.monotonic,
+        logger=None,
+    ) -> None:
+        if nproc < 1:
+            raise ValueError(f"Supervisor: nproc must be >= 1, got {nproc}")
+        self.nproc = int(nproc)
+        self.script = script
+        self.script_args = list(script_args or [])
+        self.policy = policy or RestartPolicy()
+        self.state_dir = state_dir
+        self.ckpt_dir = ckpt_dir
+        self.coordinator_port = coordinator_port
+        self.term_grace_s = float(term_grace_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self.extra_env = dict(extra_env or {})
+        self._run_generation = run_generation or self._run_generation_default
+        self._clock = clock
+        self._drain_event = threading.Event()
+        # Drain-interruptible sleep by default: a SIGTERM during backoff
+        # must stop the run now, not after the backoff expires.
+        self._sleep = sleep or (lambda s: self._drain_event.wait(s))
+        self._logger = logger
+
+        self.generations: list[GenerationRecord] = []
+        self.restarts = 0
+        self.drain_signals = 0
+        self.outcome = "running"
+        self.rc: Optional[int] = None
+        self._t0 = self._clock()
+        self._started_unix = time.time()
+        # Progress probe state (fed by on_poll during a generation).
+        self._last_ckpt_step = newest_complete_step(self.ckpt_dir)
+        self._last_progress_rel: Optional[float] = None
+        self._last_probe = 0.0
+
+    # -- signals -----------------------------------------------------------
+
+    def request_drain(self, reason: str = "signal") -> None:
+        self.drain_signals += 1
+        self._drain_event.set()
+        self._log(f"drain requested ({reason}) — forwarding to workers")
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> drain (main thread only; the CLI path).
+
+        The first Ctrl-C requests the drain and restores the previous
+        SIGINT disposition, so a second Ctrl-C interrupts hard instead
+        of being swallowed while wedged workers sit out the drain grace
+        — the same contract the worker-side
+        :func:`~rocket_tpu.resilience.faults.install_signal_drain`
+        implements."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def term_handler(signum, frame):
+            self.request_drain(signal.Signals(signum).name)
+
+        previous_int = signal.getsignal(signal.SIGINT)
+
+        def int_handler(signum, frame):
+            self.request_drain("SIGINT")
+            signal.signal(signal.SIGINT, previous_int)
+
+        signal.signal(signal.SIGTERM, term_handler)
+        signal.signal(signal.SIGINT, int_handler)
+
+    # -- progress probe ----------------------------------------------------
+
+    def _observe_progress(self, force: bool = False) -> None:
+        """Poll the checkpoint dir (>=1s apart — one listdir) and
+        timestamp the newest complete-step advance: the salvage point of
+        a generation that later crashes. ``force`` bypasses the throttle
+        for the post-generation sweep — a fast worker's final checkpoints
+        all land inside one probe interval and must still be credited."""
+        now = self._clock()
+        if not force and now - self._last_probe < 1.0:
+            return
+        self._last_probe = now
+        step = newest_complete_step(self.ckpt_dir)
+        if step is not None and step != self._last_ckpt_step:
+            self._last_ckpt_step = step
+            self._last_progress_rel = now - self._t0
+
+    # -- the default generation runner ------------------------------------
+
+    def _run_generation_default(self, gen: int, nproc: int, drain_event,
+                                on_poll):
+        from rocket_tpu import launch as launch_mod
+
+        port = self.coordinator_port or launch_mod._free_port()
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env[SUPERVISED_ENV] = "1"
+        env[GENERATION_ENV] = str(gen)
+        env[RESTARTS_ENV] = str(self.restarts)
+        group = launch_mod.WorkerGroup(
+            nproc, self.script, self.script_args, port, env=env,
+            term_grace_s=self.term_grace_s,
+        )
+        group.spawn()
+        rc, codes = group.wait(
+            drain_event=drain_event,
+            drain_grace_s=self.drain_grace_s,
+            on_poll=on_poll,
+        )
+        return rc, codes, group.output_tail(), group.coord_error.is_set()
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> int:
+        policy = self.policy
+        nproc = self.nproc
+        consecutive_failures = 0
+        failures_at_nproc = 0
+        gen = 0
+
+        while True:
+            record = GenerationRecord(
+                gen=gen, nproc=nproc, started_unix=time.time()
+            )
+            self.generations.append(record)
+            start = self._clock()
+            step_before = self._last_ckpt_step
+            self._log(
+                f"generation {gen}: launching {nproc} worker(s) "
+                f"(restarts so far: {self.restarts})"
+            )
+            result = self._run_generation(
+                gen, nproc, self._drain_event, self._observe_progress
+            )
+            rc, codes, tail = result[:3]
+            coord_error = len(result) > 3 and bool(result[3])
+            self._observe_progress(force=True)  # catch a final-save advance
+            end = self._clock()
+
+            record.duration_s = end - start
+            record.rc = rc
+            record.exit_codes = list(codes)
+            record.outcome = _classify(rc)
+            record.coord_error = coord_error
+            ckpt_progress = (
+                self._last_ckpt_step is not None
+                and self._last_ckpt_step != step_before
+            )
+            record.ckpt_step = self._last_ckpt_step
+            # With a checkpoint probe, durable advance is the ONLY
+            # progress evidence; the duration heuristic is the fallback
+            # for scripts that do not checkpoint (no ckpt_dir).
+            record.progressed = ckpt_progress or (
+                self.ckpt_dir is None
+                and record.duration_s >= policy.progress_grace_s
+            )
+            if record.outcome in ("completed", "drained"):
+                record.productive_s = record.duration_s
+            elif ckpt_progress and self._last_progress_rel is not None:
+                # Salvage: work up to the last durable checkpoint survived.
+                record.productive_s = max(
+                    0.0, min(record.duration_s,
+                             self._last_progress_rel - (start - self._t0))
+                )
+            if record.outcome not in ("completed", "drained"):
+                record.output_tail = tail or None
+
+            if record.outcome == "completed":
+                return self._finish("completed", 0)
+            if record.outcome == "drained":
+                if self.ckpt_dir is not None and self._last_ckpt_step is None:
+                    # Workers exited the drained code but the probe sees
+                    # NO durable checkpoint to resume from (a
+                    # checkpointer-less script, or every save torn) —
+                    # rc 0 would tell an orchestrator state was saved.
+                    self._log(
+                        "workers drained but no complete checkpoint "
+                        f"exists under {self.ckpt_dir!r} — not a "
+                        "certified clean stop"
+                    )
+                    return self._finish("drain_failed", rc or 1)
+                return self._finish("drained", 0)
+            if self._drain_event.is_set():
+                # Workers died (or were force-killed after the drain
+                # grace) instead of draining — not a clean stop.
+                return self._finish("drain_failed", rc or 1)
+
+            # A crashed/wedged generation: decide whether to restart.
+            if record.progressed:
+                consecutive_failures = 0
+                failures_at_nproc = 0
+            elif coord_error:
+                # Coordinator bind/connect failure at startup (a pinned
+                # --coordinator-port still in TIME_WAIT after the reap) —
+                # infrastructure noise, not the workload: retry on backoff
+                # without feeding the degrade/crash-loop counters. The
+                # restart budget still bounds a permanently-taken port.
+                self._log(
+                    "coordinator startup failure — not counted against "
+                    "the crash-loop/degrade thresholds"
+                )
+            else:
+                consecutive_failures += 1
+                failures_at_nproc += 1
+
+            if self.restarts >= policy.max_restarts:
+                self._log(
+                    f"restart budget exhausted ({policy.max_restarts}) — "
+                    "giving up"
+                )
+                return self._finish("restart_budget_exhausted", rc or 1)
+            if (
+                failures_at_nproc >= policy.degrade_after
+                and nproc > policy.min_procs
+            ):
+                # Re-resolve the surviving topology: the same count keeps
+                # dying before making progress, so assume a worker's slot
+                # is gone and restart smaller; the resharding restore
+                # handles the process-count change. Evaluated BEFORE the
+                # crash-loop verdict, and the re-resolution resets the
+                # failure streak — degradation is itself the recovery
+                # action, so each topology down to min_procs gets its own
+                # crash-loop budget (only the floor can declare a loop).
+                nproc -= 1
+                failures_at_nproc = 0
+                consecutive_failures = 0
+                self._log(
+                    f"degrading to {nproc} worker(s) after repeated "
+                    "no-progress failures (elastic restart)"
+                )
+            if consecutive_failures >= policy.crash_loop_threshold:
+                self._log(
+                    f"crash loop: {consecutive_failures} consecutive "
+                    "generations without progress — refusing to thrash"
+                )
+                return self._finish("crash_loop", rc or 1)
+
+            record.backoff_s = policy.backoff_s(consecutive_failures)
+            self._write_state()
+            self._log(
+                f"generation {gen} {record.outcome} (rc={rc}); restarting "
+                f"in {record.backoff_s:.2f}s"
+            )
+            self._sleep(record.backoff_s)
+            if self._drain_event.is_set():
+                # The drain request interrupted the backoff: the run ends
+                # on a CRASHED generation with no drain checkpoint, so the
+                # stop is honored but not certified clean — same verdict
+                # as workers dying mid-drain. Exit 0 / "drained" is
+                # reserved for a generation that actually drained.
+                return self._finish("drain_failed", rc or 1)
+            self.restarts += 1
+            gen += 1
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _finish(self, outcome: str, rc: int) -> int:
+        self.outcome = outcome
+        self.rc = rc
+        self._write_state()
+        self._log(f"supervisor: {outcome} (rc={rc})")
+        return rc
+
+    def summary(self) -> dict:
+        total = max(1e-9, self._clock() - self._t0)
+        productive = sum(g.productive_s for g in self.generations)
+        return {
+            "version": 1,
+            "script": self.script,
+            "script_args": self.script_args,
+            "nproc_initial": self.nproc,
+            "policy": dataclasses.asdict(self.policy),
+            "started_unix": self._started_unix,
+            "outcome": self.outcome,
+            "rc": self.rc,
+            "restarts": self.restarts,
+            "drain_events": self.drain_signals,
+            "generations": [g.to_json() for g in self.generations],
+            "total_wall_s": round(total, 3),
+            "productive_wall_s": round(productive, 3),
+            "goodput_fraction": round(productive / total, 4),
+            "last_ckpt_step": self._last_ckpt_step,
+        }
+
+    def _write_state(self) -> None:
+        try:
+            os.makedirs(self.state_dir, exist_ok=True)
+            path = os.path.join(self.state_dir, SUPERVISOR_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.summary(), f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as exc:  # state file is evidence, not control flow
+            self._log(f"supervisor: could not write {SUPERVISOR_FILE}: {exc!r}")
+
+    def _log(self, message: str) -> None:
+        if self._logger is not None:
+            self._logger.info("%s", message)
+        else:
+            print(f"[supervisor] {message}", flush=True)

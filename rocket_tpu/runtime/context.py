@@ -528,6 +528,43 @@ class Runtime:
         self.telemetry.health = self.health
         self.telemetry.start()
 
+        # Resilience plumbing (rocket_tpu.resilience): the drain flag every
+        # Looper polls at wave boundaries, deterministic fault injection
+        # from ROCKET_TPU_FAULTS, and — under a supervisor — the watchdog
+        # escalation turned into a restartable EXIT_WEDGED instead of a
+        # hang. The SIGTERM->drain handler installs only when a supervisor
+        # is attached (ROCKET_TPU_SUPERVISED, set by
+        # `python -m rocket_tpu.launch --supervise`) or the run opts in via
+        # ROCKET_TPU_DRAIN=1 — library code must not grab signals from an
+        # embedding application that didn't ask.
+        from rocket_tpu.resilience.faults import (
+            EXIT_WEDGED,
+            DrainState,
+            FaultInjector,
+            env_truthy,
+            install_signal_drain,
+        )
+
+        self.drain = DrainState()
+        #: Live Checkpointers across ALL phases (setup registers, destroy
+        #: unregisters): the drain path must find one even when the
+        #: draining Looper's own subtree has none (e.g. SIGTERM during an
+        #: eval phase while the train phase owns the Checkpointer).
+        self.checkpointers: list = []
+        self.faults = FaultInjector.from_env(
+            process_index=self.process_index,
+            logger=self.get_logger("resilience"),
+        )
+        if self.faults is not None:
+            self.faults.install()
+        self.supervised = env_truthy("ROCKET_TPU_SUPERVISED")
+        if self.supervised:
+            self.telemetry.escalation_exit_code = EXIT_WEDGED
+        if self.supervised or env_truthy("ROCKET_TPU_DRAIN"):
+            install_signal_drain(
+                self.drain, logger=self.get_logger("resilience")
+            )
+
         self._warned_replicated_batch = False
 
     # -- mesh & sharding ---------------------------------------------------

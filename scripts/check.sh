@@ -3,8 +3,9 @@
 # SPMD shard audit (self-gate + budget diff) + precision audit
 # (dtype-flow self-gate + numerics budgets) + schedule audit + serving
 # audit (retrace-surface/latency/HBM self-gate + serving budgets) +
-# obs telemetry smoke + the tier-1 test suite (command from
-# ROADMAP.md). Exits non-zero on the first failing stage.
+# obs telemetry smoke + resilience smoke (supervised restart / drain) +
+# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on
+# the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -62,6 +63,15 @@ echo "== blackbox smoke (injected NaN -> skip_step / forensic bundle) =="
 # params and a counted skip; under dump_and_halt it must halt and leave a
 # complete runs/**/blackbox/ bundle the post-mortem CLI renders.
 JAX_PLATFORMS=cpu python scripts/blackbox_smoke.py
+
+echo "== resilience smoke (supervised restart after injected kill + SIGTERM drain) =="
+# The supervised launcher must survive deterministic fault injection:
+# one leg SIGKILLs the worker mid-run (supervisor restarts from the
+# latest checkpoint, training reaches the target step, goodput_fraction
+# >= 0.5 in supervisor.json), one leg SIGTERMs the supervisor (worker
+# drains: emergency checkpoint + distinguished drained exit code, and a
+# fresh supervised launch resumes from it).
+JAX_PLATFORMS=cpu python scripts/resilience_smoke.py
 
 echo "== serve smoke (continuous batching + paged KV + compiled-once) =="
 # A 50-request synthetic workload through rocket_tpu.serve plus the

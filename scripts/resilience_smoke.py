@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Resilience smoke gate: supervised training must survive injected faults.
+
+Two legs over the REAL ``python -m rocket_tpu.launch --supervise`` path
+(subprocess workers, resume via ``Checkpointer(resume_from="latest")``):
+
+* **injected kill** — ``ROCKET_TPU_FAULTS=kill:step=23`` SIGKILLs the
+  worker mid-run; the supervisor must restart it, training must reach the
+  target step with a finite loss, and ``supervisor.json`` must report
+  ``restarts >= 1`` and ``goodput_fraction >= 0.5``;
+* **SIGTERM drain** — SIGTERM to the supervisor mid-run must drain the
+  worker (in-flight wave finished, emergency drain checkpoint written,
+  worker exits the drained code, supervisor exits 0), and a fresh
+  supervised launch must resume from that checkpoint and complete.
+
+Exits non-zero on the first violated invariant (wired into
+scripts/check.sh and CI).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rocket_tpu.resilience import (  # noqa: E402
+    EXIT_DRAINED,
+    newest_complete_step,
+)
+
+#: 320 samples / batch 32 = 10 waves per epoch x 6 epochs = 60 steps,
+#: checkpointed every 5. The kill at wave 23 lands in epoch 2 with
+#: checkpoints at 5..20 already durable.
+TARGET_STEP = 60
+
+_TRAIN = r"""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.runtime.context import Runtime
+
+WORKDIR = os.environ["WORKDIR"]
+runtime = Runtime(seed=0, project_dir=WORKDIR, telemetry=True)
+
+
+def cross_entropy(batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+rng = np.random.default_rng(0)
+data = [
+    {"image": rng.normal(size=8).astype(np.float32), "label": np.int32(i % 4)}
+    for i in range(320)
+]
+
+module = rt.Module(
+    MLP(in_features=8, num_classes=4, hidden=(16,)),
+    capsules=[rt.Loss(cross_entropy),
+              rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+)
+
+
+class Grab(rt.Capsule):
+    def __init__(self):
+        super().__init__(priority=10)
+        self.step = None
+        self.loss = None
+
+    def launch(self, attrs=None):
+        if module.state is not None:
+            self.step = module.state["step"]
+        if (attrs is not None and attrs.looper is not None
+                and attrs.looper.state and "loss" in attrs.looper.state):
+            self.loss = attrs.looper.state["loss"]
+
+
+class Throttle(rt.Capsule):
+    # Optional per-wave sleep (WAVE_SLEEP env) so the drain leg's SIGTERM
+    # reliably lands mid-training instead of racing a sub-second run.
+    def __init__(self, secs):
+        super().__init__(priority=20)
+        self._secs = secs
+
+    def launch(self, attrs=None):
+        if self._secs:
+            import time
+
+            time.sleep(self._secs)
+
+
+grab = Grab()
+tree = rt.Launcher(
+    [rt.Looper(
+        [rt.Dataset(data, batch_size=32, device_cache=False),
+         module, grab,
+         Throttle(float(os.environ.get("WAVE_SLEEP", "0") or 0)),
+         rt.Checkpointer(output_dir=os.path.join(WORKDIR, "ckpts"),
+                         save_every=5, resume_from="latest")],
+        tag="train", progress=False)],
+    num_epochs=6, statefull=True, runtime=runtime,
+)
+tree.launch()
+final = {"step": int(np.asarray(jax.device_get(grab.step))),
+         "loss": float(np.asarray(jax.device_get(grab.loss)))}
+with open(os.path.join(WORKDIR, "done.json"), "w") as f:
+    json.dump(final, f)
+print("TRAIN_DONE", json.dumps(final), flush=True)
+"""
+
+
+def check(condition, message):
+    if not condition:
+        print(f"resilience smoke FAILED: {message}", file=sys.stderr)
+        sys.exit(1)
+
+
+def _workdir(prefix):
+    # Under the repo's (gitignored) runs/ — NOT the system tmpdir — so a
+    # failing CI run's supervisor.json + telemetry land inside the
+    # workspace where the runs/** artifact-upload step can find them.
+    # A SUCCESSFUL leg removes its workdir (check() exits before the
+    # cleanup on any failure), so repeated local runs don't accumulate
+    # checkpoint trees.
+    repo_runs = os.path.join(REPO, "runs")
+    os.makedirs(repo_runs, exist_ok=True)
+    return tempfile.mkdtemp(prefix=prefix, dir=repo_runs)
+
+
+def _setup(workdir, extra_env=None):
+    script = os.path.join(workdir, "train.py")
+    with open(script, "w") as f:
+        f.write(_TRAIN)
+    env = dict(os.environ)
+    env.update(REPO_ROOT=REPO, WORKDIR=workdir, JAX_PLATFORMS="cpu")
+    env.pop("ROCKET_TPU_FAULTS", None)
+    env.update(extra_env or {})
+    state_dir = os.path.join(workdir, "runs", "telemetry")
+    cmd = [
+        sys.executable, "-m", "rocket_tpu.launch", "--supervise", "-n", "1",
+        "--ckpt-dir", os.path.join(workdir, "ckpts"),
+        "--state-dir", state_dir,
+        "--backoff", "0.1", "--progress-grace", "0.5",
+        "--term-grace", "10", "--drain-grace", "60",
+        script,
+    ]
+    return cmd, env, state_dir
+
+
+def _read_supervisor(state_dir):
+    with open(os.path.join(state_dir, "supervisor.json")) as f:
+        return json.load(f)
+
+
+def leg_injected_kill():
+    workdir = _workdir("resilience_kill_")
+    cmd, env, state_dir = _setup(
+        workdir, {"ROCKET_TPU_FAULTS": "kill:step=23"}
+    )
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=600)
+    check(proc.returncode == 0,
+          f"supervised run exited {proc.returncode}:\n{proc.stdout[-2000:]}"
+          f"\n{proc.stderr[-1000:]}")
+
+    done = json.load(open(os.path.join(workdir, "done.json")))
+    check(done["step"] == TARGET_STEP,
+          f"training did not reach step {TARGET_STEP}: {done}")
+    check(done["loss"] == done["loss"] and abs(done["loss"]) < 1e9,
+          f"non-finite final loss: {done}")
+
+    sup = _read_supervisor(state_dir)
+    check(sup["outcome"] == "completed", f"outcome {sup['outcome']!r}")
+    check(sup["restarts"] >= 1, f"no restart recorded: {sup['restarts']}")
+    check(len(sup["generations"]) >= 2, "fewer than 2 generations")
+    check(sup["generations"][0]["outcome"] == "crashed",
+          f"gen 0 outcome {sup['generations'][0]['outcome']!r} "
+          "(the injected SIGKILL)")
+    check(sup["goodput_fraction"] >= 0.5,
+          f"goodput_fraction {sup['goodput_fraction']} < 0.5 under one "
+          "injected kill")
+
+    # The obs report CLI folds the supervisor section into the telemetry
+    # report (supervisor.json sits next to telemetry.json).
+    report = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "report",
+         os.path.join(state_dir, "telemetry.json")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    check(report.returncode == 0, f"obs report failed: {report.stderr[-400:]}")
+    check("supervisor: outcome=completed" in report.stdout,
+          f"obs report missing supervisor section:\n{report.stdout}")
+    shutil.rmtree(workdir, ignore_errors=True)
+    return sup
+
+
+def leg_sigterm_drain():
+    workdir = _workdir("resilience_drain_")
+    # ~80ms per wave => ~5s of training: the SIGTERM below cannot race a
+    # sub-second run to completion.
+    cmd, env, state_dir = _setup(workdir, {"WAVE_SLEEP": "0.08"})
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    # Wait for durable progress, then deliver the preemption notice.
+    deadline = time.time() + 300
+    ckpt_dir = os.path.join(workdir, "ckpts")
+    while time.time() < deadline:
+        step = newest_complete_step(ckpt_dir)
+        if step is not None and step >= 5:
+            break
+        if proc.poll() is not None:
+            out = proc.communicate()[0]
+            check(False, f"supervised run died before progress:\n{out[-2000:]}")
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        check(False, "no checkpoint progress within 300s")
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out = proc.communicate(timeout=120)[0]
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        check(False, "supervisor did not exit within 120s of SIGTERM")
+    check(proc.returncode == 0,
+          f"drain exited {proc.returncode} (expected clean 0):\n{out[-2000:]}")
+
+    sup = _read_supervisor(state_dir)
+    check(sup["outcome"] == "drained", f"outcome {sup['outcome']!r}")
+    check(sup["drain_events"] >= 1, "no drain event recorded")
+    last = sup["generations"][-1]
+    check(last["outcome"] == "drained", f"generation outcome {last!r}")
+    check(EXIT_DRAINED in last["exit_codes"],
+          f"worker did not exit the drained code: {last['exit_codes']}")
+
+    # The drain left an emergency checkpoint in the numbered layout.
+    drained_step = newest_complete_step(ckpt_dir)
+    check(drained_step is not None, "no complete checkpoint after drain")
+    marker = os.path.join(ckpt_dir, str(drained_step), "drain.json")
+    check(os.path.exists(marker),
+          f"drain checkpoint marker missing at {marker}")
+
+    # A fresh supervised launch resumes from the drained checkpoint and
+    # completes to the target step.
+    cmd2, env2, state_dir2 = _setup(workdir)
+    proc2 = subprocess.run(cmd2, env=env2, cwd=REPO, capture_output=True,
+                           text=True, timeout=600)
+    check(proc2.returncode == 0,
+          f"resume-after-drain exited {proc2.returncode}:"
+          f"\n{proc2.stdout[-2000:]}")
+    done = json.load(open(os.path.join(workdir, "done.json")))
+    check(done["step"] == TARGET_STEP,
+          f"resume-after-drain did not reach step {TARGET_STEP}: {done}")
+    shutil.rmtree(workdir, ignore_errors=True)
+    return sup, drained_step
+
+
+def main(argv=None) -> None:
+    # --leg/--json-out exist for bench.py's `resilience_summary`, which
+    # runs the kill leg as a subprocess probe and reads the record back.
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--leg", choices=["all", "kill", "drain"],
+                        default="all")
+    parser.add_argument("--json-out", default=None,
+                        help="write the kill leg's headline record here")
+    args = parser.parse_args(argv)
+
+    sup_kill = None
+    if args.leg in ("all", "kill"):
+        sup_kill = leg_injected_kill()
+        if args.json_out:
+            record = {
+                "outcome": sup_kill["outcome"],
+                "restarts": sup_kill["restarts"],
+                "generations": len(sup_kill["generations"]),
+                "goodput_fraction": sup_kill["goodput_fraction"],
+                "total_wall_s": sup_kill["total_wall_s"],
+                "target_step": TARGET_STEP,
+                "fault": "kill:step=23",
+            }
+            with open(args.json_out, "w") as f:
+                json.dump(record, f)
+    if args.leg in ("all", "drain"):
+        sup_drain, drained_step = leg_sigterm_drain()
+
+    if args.leg == "all":
+        print(
+            "resilience smoke OK: injected kill survived with "
+            f"{sup_kill['restarts']} restart(s), goodput_fraction="
+            f"{sup_kill['goodput_fraction']}; SIGTERM drained cleanly at "
+            f"checkpoint step {drained_step} and resumed to step "
+            f"{TARGET_STEP}"
+        )
+    else:
+        print(f"resilience smoke OK ({args.leg} leg)")
+
+
+if __name__ == "__main__":
+    main()

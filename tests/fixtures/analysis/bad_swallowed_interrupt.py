@@ -1,0 +1,56 @@
+"""RKT110 true positives: broad except-and-continue inside retry loops."""
+
+import time
+
+
+def supervise_forever(run_once):
+    # Bare except in a supervision loop: Ctrl-C and SystemExit (the
+    # graceful-drain exit) are swallowed and the loop spins on.
+    while True:
+        try:
+            run_once()
+        except:  # noqa: E722 — the fixture plants exactly this hazard
+            time.sleep(1.0)
+
+
+def retry_with_base_exception(fn):
+    # BaseException without re-raise: same swallow, spelled explicitly.
+    for _attempt in range(5):
+        try:
+            return fn()
+        except BaseException:
+            continue
+    return None
+
+
+def eats_keyboard_interrupt(jobs):
+    # Naming the interrupt directly and falling through is no better.
+    for job in jobs:
+        try:
+            job()
+        except (ValueError, KeyboardInterrupt):
+            pass
+
+
+def nested_break_is_not_terminal(fn, cleanups):
+    # The break belongs to the INNER for loop: the outer supervision loop
+    # still swallows the interrupt and continues iterating.
+    while True:
+        try:
+            fn()
+        except BaseException:
+            for cleanup in cleanups:
+                cleanup()
+                break
+
+
+def nested_return_is_not_terminal(fn, on_error):
+    # The return sits in a nested function — it leaves the callback, not
+    # this loop; the handler itself falls through and spins on.
+    while True:
+        try:
+            fn()
+        except BaseException:
+            def callback():
+                return "handled"
+            on_error(callback)

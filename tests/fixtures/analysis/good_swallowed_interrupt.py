@@ -1,0 +1,53 @@
+"""RKT110 clean negatives: disciplined exception handling around loops."""
+
+
+def supervise_forever(run_once, backoff):
+    # Catching Exception is the correct "retry on any failure" spelling:
+    # KeyboardInterrupt/SystemExit still propagate and stop the loop.
+    while True:
+        try:
+            run_once()
+        except Exception:
+            backoff()
+
+
+def reraise_is_terminal(fn, cleanup):
+    # A broad catch that RE-RAISES leaves nothing swallowed.
+    for _attempt in range(5):
+        try:
+            return fn()
+        except BaseException:
+            cleanup()
+            raise
+    return None
+
+
+def break_is_terminal(fn):
+    # Leaving the loop on interrupt is the cooperative-shutdown idiom.
+    while True:
+        try:
+            fn()
+        except KeyboardInterrupt:
+            break
+
+
+def break_after_inner_loop_is_terminal(fn, cleanups):
+    # The inner loop runs to completion, then the handler's OWN break
+    # leaves the supervision loop — terminal.
+    while True:
+        try:
+            fn()
+        except BaseException:
+            for cleanup in cleanups:
+                cleanup()
+            break
+
+
+def outside_any_loop(fn, fallback):
+    # Not a retry loop: a one-shot cleanup try at function level is out
+    # of scope for this rule (ruff's E722 still has opinions on bare
+    # except; this fixture uses BaseException deliberately).
+    try:
+        return fn()
+    except BaseException:
+        return fallback

@@ -45,6 +45,7 @@ AST_CASES = [
     ("RKT107", "fork_start_method"),
     ("RKT108", "string_dtype"),
     ("RKT109", "unlocked_mutation"),
+    ("RKT110", "swallowed_interrupt"),
 ]
 
 

@@ -322,6 +322,30 @@ def test_write_detail_carries_health_sentinel_record(tmp_path):
     assert "health_sentinels" not in json.loads(path.read_text())
 
 
+def test_write_detail_carries_resilience_record(tmp_path):
+    """BENCH_DETAIL.json carries the supervised-restart probe's headline
+    (goodput under one injected kill through the real supervisor) when
+    main() hands a record over — and omits the section otherwise."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    probe = {
+        "outcome": "completed",
+        "restarts": 1,
+        "generations": 2,
+        "goodput_fraction": 0.97,
+        "total_wall_s": 12.3,
+        "target_step": 60,
+        "fault": "kill:step=23",
+    }
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path),
+                       resilience=probe)
+    record = json.loads(path.read_text())["resilience"]
+    assert record["goodput_fraction"] == 0.97
+    assert record["restarts"] == 1 and record["outcome"] == "completed"
+
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    assert "resilience" not in json.loads(path.read_text())
+
+
 def test_write_detail_partial_run_keeps_gpt2_headline(tmp_path):
     """The merged record's headline must stay gpt2 after a debug run of
     a different config."""

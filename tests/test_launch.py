@@ -1,5 +1,7 @@
-"""python -m rocket_tpu.launch: spawns N coordinated processes."""
+"""python -m rocket_tpu.launch: spawns N coordinated processes; with
+--supervise, restarts crashed generations and drains on SIGTERM."""
 
+import json
 import pytest
 import os
 import subprocess
@@ -116,3 +118,157 @@ def test_launch_tears_down_stragglers(tmp_path):
     )
     assert out.returncode != 0
     assert time.time() - t0 < 60  # did not wait out rank 0's sleep
+
+
+@pytest.mark.slow
+def test_launch_kills_sigterm_ignoring_straggler(tmp_path):
+    """Straggler teardown must escalate SIGTERM -> SIGKILL after the
+    bounded --term-grace: a worker that installs SIG_IGN for SIGTERM
+    (or is wedged in a collective, same observable) cannot hang the
+    launcher forever."""
+    import time
+
+    script = tmp_path / "stubborn.py"
+    script.write_text(
+        "import os, signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "if os.environ['JAX_PROCESS_ID'] == '1':\n"
+        "    time.sleep(1)\n"
+        "    sys.exit(5)\n"
+        "time.sleep(600)\n"  # rank 0 ignores the TERM and 'hangs'
+    )
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.launch", "-n", "2",
+         "--term-grace", "2", str(script)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode != 0
+    assert time.time() - t0 < 60  # TERM grace + KILL, not rank 0's sleep
+
+
+@pytest.mark.slow
+def test_worker_initiated_drain_releases_blocked_peers(tmp_path):
+    """A rank exiting EXIT_DRAINED on its own (a per-rank preemption
+    notice) must start the SIGTERM-forward + drain-grace clock for its
+    peers: a peer blocked in a collective waiting for the drained rank
+    would otherwise hang wait() forever (EXIT_DRAINED sets neither
+    failure_rc nor, by itself, any deadline)."""
+    import time
+
+    from rocket_tpu.launch import WorkerGroup
+    from rocket_tpu.resilience.faults import EXIT_DRAINED
+
+    script = tmp_path / "split_drain.py"
+    script.write_text(
+        "import os, signal, sys, time\n"
+        "if os.environ['JAX_PROCESS_ID'] == '1':\n"
+        "    time.sleep(0.5)\n"
+        f"    sys.exit({EXIT_DRAINED})\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "time.sleep(600)\n"  # rank 0 'wedged in a collective'
+    )
+    group = WorkerGroup(2, str(script), term_grace_s=2.0)
+    group.spawn()
+    t0 = time.time()
+    rc, codes = group.wait(drain_grace_s=2.0)
+    assert time.time() - t0 < 60  # grace + TERM->KILL, not rank 0's sleep
+    assert codes[1] == EXIT_DRAINED
+    assert rc != 0  # the wedged peer could not drain: not a clean stop
+
+
+def test_plain_launch_passes_drain_grace_to_wait(monkeypatch):
+    """--drain-grace must reach WorkerGroup.wait in PLAIN mode too: a
+    worker-initiated drain's peer-teardown deadline is the flag the user
+    set, not the hardcoded 60 s default (regression: _run_once used to
+    call wait() with no drain_grace_s)."""
+    import argparse
+
+    import rocket_tpu.launch as launch
+
+    seen = {}
+    monkeypatch.setattr(launch.WorkerGroup, "spawn", lambda self: None)
+
+    def fake_wait(self, drain_event=None, drain_grace_s=60.0, on_poll=None):
+        seen["drain_grace_s"] = drain_grace_s
+        return 0, [0]
+
+    monkeypatch.setattr(launch.WorkerGroup, "wait", fake_wait)
+    monkeypatch.setattr(launch.WorkerGroup, "teardown", lambda self: None)
+    args = argparse.Namespace(
+        nproc=1, script="train.py", script_args=[],
+        term_grace=10.0, drain_grace=7.5,
+    )
+    rc, _ = launch._run_once(args, port=45555)
+    assert rc == 0
+    assert seen["drain_grace_s"] == 7.5
+
+
+@pytest.mark.slow
+def test_supervised_launch_restarts_until_success(tmp_path):
+    """--supervise: a generation-0 crash is an event, not a verdict —
+    the worker is relaunched (with ROCKET_TPU_GENERATION advanced) and
+    the clean second generation ends the run with exit 0 and a
+    supervisor.json recording the restart."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['ROCKET_TPU_GENERATION'] == '0' else 0)\n"
+    )
+    state_dir = tmp_path / "state"
+    out = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.launch", "--supervise", "-n", "1",
+         "--backoff", "0.05", "--progress-grace", "0.01",
+         "--state-dir", str(state_dir), str(script)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    state = json.loads((state_dir / "supervisor.json").read_text())
+    assert state["outcome"] == "completed"
+    assert state["restarts"] == 1
+    assert [g["outcome"] for g in state["generations"]] == [
+        "crashed", "completed"]
+    assert 0.0 <= state["goodput_fraction"] <= 1.0
+
+
+@pytest.mark.slow
+def test_supervised_launch_honors_drained_worker(tmp_path):
+    """A worker exiting the distinguished drained code is a CLEAN stop:
+    the supervisor exits 0 without restarting."""
+    from rocket_tpu.resilience import EXIT_DRAINED
+
+    script = tmp_path / "drainer.py"
+    script.write_text(f"import sys; sys.exit({EXIT_DRAINED})\n")
+    state_dir = tmp_path / "state"
+    out = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.launch", "--supervise", "-n", "1",
+         "--state-dir", str(state_dir), str(script)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    state = json.loads((state_dir / "supervisor.json").read_text())
+    assert state["outcome"] == "drained"
+    assert state["restarts"] == 0
+    assert state["generations"][0]["exit_codes"] == [EXIT_DRAINED]
+
+
+@pytest.mark.slow
+def test_supervised_launch_crash_loop_gives_up(tmp_path):
+    """A deterministic crasher must not be restarted forever: after the
+    crash-loop threshold the supervisor refuses to thrash, exits
+    non-zero, and supervisor.json carries the failing output tail."""
+    script = tmp_path / "dead.py"
+    script.write_text("import sys; print('boom-trail'); sys.exit(9)\n")
+    state_dir = tmp_path / "state"
+    out = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.launch", "--supervise", "-n", "1",
+         "--backoff", "0.05", "--crash-loop", "2", "--progress-grace", "1e9",
+         "--state-dir", str(state_dir), str(script)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode != 0
+    state = json.loads((state_dir / "supervisor.json").read_text())
+    assert state["outcome"] == "crash_loop"
+    assert len(state["generations"]) == 2
+    tail = state["generations"][-1]["output_tail"]
+    assert any("boom-trail" in line for line in tail["0"])

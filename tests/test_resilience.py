@@ -1,0 +1,840 @@
+"""rocket_tpu.resilience: fault plans, drain protocol, supervisor loop.
+
+Fast tier: fault-plan parsing/determinism, injector hooks with injected
+action fns, the in-process Looper drain path (SIGTERM semantics without a
+process spawn: request the drain programmatically, assert the
+GracefulDrain SystemExit, the drain checkpoint on disk, and the resumed
+run completing), supervisor control flow with a scripted generation
+runner (restart budget, crash-loop refusal, elastic degradation, drain
+honoring, goodput accounting), and the watchdog-escalation exit wiring.
+The process-spawning legs live in scripts/resilience_smoke.py (CI) and
+the slow-tier launch/multiprocess tests.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu import optim
+from rocket_tpu.models.mlp import MLP
+from rocket_tpu.resilience import (
+    EXIT_DRAINED,
+    EXIT_WEDGED,
+    DrainState,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    GracefulDrain,
+    RestartPolicy,
+    Supervisor,
+    install_signal_drain,
+    is_complete_checkpoint,
+    newest_complete_step,
+)
+from rocket_tpu.runtime.context import Runtime
+
+
+def cross_entropy(batch):
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        batch["logits"], batch["label"]
+    ).mean()
+
+
+def class_data(n=128):
+    rng = np.random.default_rng(0)
+    return [
+        {"image": rng.normal(size=8).astype(np.float32),
+         "label": np.int32(i % 4)}
+        for i in range(n)
+    ]
+
+
+# -- FaultPlan ---------------------------------------------------------------
+
+
+def test_fault_plan_parse_roundtrip():
+    spec = "kill:step=23;sigterm:wall=3.5;wedge:step=7,secs=600;poison:step=3,rank=1,gen=1"
+    plan = FaultPlan.parse(spec)
+    assert [f.kind for f in plan] == ["kill", "sigterm", "wedge", "poison"]
+    assert plan.faults[0].step == 23 and plan.faults[0].gen == 0
+    assert plan.faults[1].wall == 3.5
+    assert plan.faults[2].secs == 600.0
+    assert plan.faults[3] == Fault("poison", step=3, rank=1, gen=1)
+    # The wire format round-trips through parse(to_spec()).
+    again = FaultPlan.parse(plan.to_spec())
+    assert again.faults == plan.faults
+
+
+@pytest.mark.parametrize("bad", [
+    "frobnicate:step=1",          # unknown kind
+    "kill:when=now",              # unknown key
+    "kill:gen=0",                 # kill needs step=
+    "sigterm:rank=1",             # sigterm needs step= or wall=
+    "kill:step",                  # malformed item
+])
+def test_fault_plan_strict_parse(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_fault_plan_sample_is_deterministic():
+    a = FaultPlan.sample(seed=7, max_step=50, nproc=4, n=3)
+    b = FaultPlan.sample(seed=7, max_step=50, nproc=4, n=3)
+    assert a.faults == b.faults
+    assert FaultPlan.sample(seed=8, max_step=50, nproc=4, n=3).faults != a.faults
+    for fault in a:
+        assert 1 <= fault.step < 50
+        assert fault.rank is None or 0 <= fault.rank < 4
+
+
+def test_injector_scopes_by_generation_and_rank():
+    plan = FaultPlan.parse("kill:step=2,rank=1;sigterm:step=5,gen=1")
+    # Rank 0, generation 0: nothing matches.
+    inj = FaultInjector(plan, process_index=0, generation=0,
+                        kill_fn=lambda: None)
+    assert inj.active == []
+    # Rank 1, generation 0: only the kill.
+    inj = FaultInjector(plan, process_index=1, generation=0,
+                        kill_fn=lambda: None)
+    assert [f.kind for f in inj.active] == ["kill"]
+    # Generation 1 (the restart): only the gen=1 sigterm — a restarted
+    # generation is not re-killed by generation-0 faults.
+    inj = FaultInjector(plan, process_index=1, generation=1,
+                        kill_fn=lambda: None)
+    assert [f.kind for f in inj.active] == ["sigterm"]
+
+
+def test_injector_from_env(monkeypatch):
+    assert FaultInjector.from_env(environ={}) is None
+    env = {"ROCKET_TPU_FAULTS": "kill:step=4", "ROCKET_TPU_GENERATION": "2"}
+    inj = FaultInjector.from_env(environ=env)
+    assert inj is not None and inj.generation == 2
+    assert inj.active == []  # the fault is gen=0, we are gen 2
+
+
+def test_injector_step_hook_fires_at_step():
+    fired = []
+    plan = FaultPlan.parse("kill:step=3")
+    inj = FaultInjector(plan, kill_fn=lambda: fired.append("kill"))
+    for i in range(5):
+        inj.step_hook("train", i)
+    assert fired == ["kill"]
+    assert inj.fired == ("kill@train[2]",)
+
+
+def test_injector_wedge_sleeps():
+    slept = []
+    plan = FaultPlan.parse("wedge:step=2,secs=123")
+    inj = FaultInjector(plan, sleep_fn=slept.append)
+    inj.step_hook("train", 0)
+    inj.step_hook("train", 1)
+    assert slept == [123.0]
+
+
+def test_injector_poison_hook_nans_exactly_one_batch():
+    plan = FaultPlan.parse("poison:step=2")
+    inj = FaultInjector(plan)
+    batch = {"image": np.ones((4, 8), np.float32), "label": np.arange(4)}
+    first = inj.poison_hook(batch)
+    assert np.isfinite(first["image"]).all()
+    second = inj.poison_hook(batch)
+    assert np.isnan(second["image"]).all()
+    # Integer leaves pass through untouched (NaN has no int encoding).
+    assert (second["label"] == batch["label"]).all()
+    third = inj.poison_hook(batch)
+    assert np.isfinite(third["image"]).all()
+
+
+def test_injector_poison_hook_poisons_device_resident_batches():
+    """A DeviceCachedLoader (the default device_cache="auto" path for
+    small datasets) yields jax Arrays, not np.ndarrays — the poison must
+    still land (duck-typed dtype/shape match), as a host NaN array the
+    step places like any other input."""
+    import jax.numpy as jnp
+
+    plan = FaultPlan.parse("poison:step=1")
+    inj = FaultInjector(plan)
+    batch = {"image": jnp.ones((4, 8), jnp.float32),
+             "label": jnp.arange(4)}
+    out = inj.poison_hook(batch)
+    assert np.isnan(np.asarray(out["image"])).all()
+    assert (np.asarray(out["label"]) == np.arange(4)).all()
+    assert inj.fired == ("poison@batch[1]",)
+
+
+def test_injector_poison_hook_marker_batch_is_not_counted_as_fired():
+    """Fused device-gather MARKER batches share their cache leaf across
+    every step — NaN-filling it would poison the whole rest of the run,
+    so the hook must pass the batch through untouched AND must not record
+    the fault as fired (a silently no-op fault reads as a vacuously
+    passing test)."""
+    plan = FaultPlan.parse("poison:step=1")
+    inj = FaultInjector(plan)
+    cache = np.ones((16, 8), np.float32)
+    batch = {"_device_gather": {"cache": {"image": cache},
+                                "perm": np.arange(16), "index": 0}}
+    out = inj.poison_hook(batch)
+    assert out is batch
+    assert np.isfinite(cache).all()
+    assert inj.fired == ()
+
+
+# -- drain protocol ----------------------------------------------------------
+
+
+def test_graceful_drain_is_systemexit_with_drained_code():
+    exc = GracefulDrain(checkpoint="/tmp/x", reason="SIGTERM")
+    assert isinstance(exc, SystemExit)
+    assert exc.code == EXIT_DRAINED
+    assert exc.checkpoint == "/tmp/x"
+    # NOT an Exception: the Looper's crash-forensics handler must not
+    # treat a drain as a failure.
+    assert not isinstance(exc, Exception)
+
+
+def test_drain_state_latches_first_request():
+    drain = DrainState()
+    assert not drain.requested
+    drain.request("SIGTERM")
+    drain.request("later")
+    assert drain.requested and drain.reason == "SIGTERM"
+    assert drain.requested_at is not None
+
+
+def test_install_signal_drain_routes_sigterm():
+    drain = DrainState()
+    previous = signal.getsignal(signal.SIGTERM)
+    previous_int = signal.getsignal(signal.SIGINT)
+    try:
+        assert install_signal_drain(drain)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert drain.requested and drain.reason == "SIGTERM"
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        signal.signal(signal.SIGINT, previous_int)
+
+
+def test_install_signal_drain_routes_first_sigint_then_restores():
+    """An interactive Ctrl-C reaches the whole foreground process group:
+    the first SIGINT must drain (not die mid-orchestration with a
+    KeyboardInterrupt), and the handler must restore the previous SIGINT
+    disposition so a second Ctrl-C interrupts hard."""
+    drain = DrainState()
+    previous = signal.getsignal(signal.SIGTERM)
+    previous_int = signal.getsignal(signal.SIGINT)
+    try:
+        assert install_signal_drain(drain)
+        assert signal.getsignal(signal.SIGINT) is not previous_int
+        os.kill(os.getpid(), signal.SIGINT)
+        assert drain.requested and drain.reason == "SIGINT"
+        assert signal.getsignal(signal.SIGINT) is previous_int
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        signal.signal(signal.SIGINT, previous_int)
+
+
+class DrainAt(rt.Capsule):
+    """Requests a drain after N completed waves (the programmatic stand-in
+    for a SIGTERM landing mid-run)."""
+
+    def __init__(self, after):
+        super().__init__(priority=500)
+        self._after = after
+        self._seen = 0
+
+    def launch(self, attrs=None):
+        self._seen += 1
+        if self._seen == self._after:
+            self._runtime.drain.request("test-preemption")
+
+
+class GrabState(rt.Capsule):
+    """Mirrors the module's latest step/params so they stay inspectable
+    after DESTROY tears the tree down."""
+
+    def __init__(self, module):
+        super().__init__(priority=10)
+        self._module = module
+        self.step = None
+        self.params = None
+
+    def launch(self, attrs=None):
+        if self._module.state is not None:
+            self.step = self._module.state["step"]
+            self.params = self._module.state["params"]
+
+
+def _tree(runtime, ckpt_dir, drain_after=None, save_every=1000,
+          num_epochs=2, keep_last=None):
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    grab = GrabState(module)
+    capsules = [
+        rt.Dataset(class_data(), batch_size=32, device_cache=False),
+        module,
+        grab,
+    ]
+    if drain_after is not None:
+        capsules.append(DrainAt(drain_after))
+    capsules.append(
+        rt.Checkpointer(output_dir=ckpt_dir, save_every=save_every,
+                        resume_from="latest", keep_last=keep_last)
+    )
+    launcher = rt.Launcher(
+        [rt.Looper(capsules, tag="train", progress=False)],
+        num_epochs=num_epochs, runtime=runtime,
+    )
+    return launcher, grab
+
+
+def test_looper_drain_checkpoints_and_exits_drained(tmp_path):
+    """The full worker-side drain path, in process: a drain request is
+    honored at the next wave boundary — synchronous emergency checkpoint
+    in the numbered layout (drain.json marker, capsules included), then
+    GracefulDrain(EXIT_DRAINED) through the normal teardown — and a
+    fresh run resumes from it via resume_from="latest" and completes."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path), telemetry=True)
+    launcher, grab = _tree(runtime, ckpt_dir, drain_after=3)
+    with pytest.raises(SystemExit) as excinfo:
+        launcher.launch()
+    assert excinfo.value.code == EXIT_DRAINED
+    assert isinstance(excinfo.value, GracefulDrain)
+
+    # Drain happened at the boundary AFTER wave 3: the checkpoint is the
+    # numbered step-3 directory, complete and marked as a drain save.
+    path = excinfo.value.checkpoint
+    assert path is not None and os.path.isdir(path), path
+    assert os.path.basename(path) == "3"
+    assert is_complete_checkpoint(path)
+    assert newest_complete_step(ckpt_dir) == 3
+    with open(os.path.join(path, "drain.json")) as f:
+        marker = json.load(f)
+    assert marker["reason"] == "drain" and marker["step"] == 3
+    assert os.path.exists(os.path.join(path, "capsules.pkl"))
+    # The drain rode the telemetry registry and teardown still flushed.
+    tel = os.path.join(str(tmp_path), "runs", "telemetry", "telemetry.json")
+    assert os.path.exists(tel)
+    with open(tel) as f:
+        assert json.load(f)["metrics"]["counters"]["resilience/drains"] == 1
+
+    # Restart: resume_from="latest" picks the drain checkpoint; training
+    # continues mid-epoch and completes both epochs (4 waves/epoch).
+    runtime2 = Runtime(seed=0, project_dir=str(tmp_path / "r2"))
+    launcher2, grab2 = _tree(runtime2, ckpt_dir)
+    launcher2.launch()
+    assert int(np.asarray(grab2.step)) == 8
+    for leaf in jax.tree.leaves(jax.device_get(grab2.params)):
+        assert np.isfinite(leaf).all()
+
+
+def test_drain_checkpoint_joins_keep_last_rotation_after_resume(tmp_path):
+    """The drain step must be recorded in the PICKLED capsule state —
+    appended to saved_steps BEFORE save_emergency snapshots capsules (the
+    _save_sync idiom) — so a resumed run's keep_last rotation prunes the
+    drain directory like any periodic save. Without the ordering, every
+    drain leaks a full checkpoint on disk forever."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    launcher, _ = _tree(runtime, ckpt_dir, drain_after=3)
+    with pytest.raises(SystemExit):
+        launcher.launch()
+    assert os.path.isdir(os.path.join(ckpt_dir, "3"))
+
+    # Resume with a rotating Checkpointer: saves at 4/6/8 with
+    # keep_last=2 must rotate the step-3 drain save out.
+    runtime2 = Runtime(seed=0, project_dir=str(tmp_path / "r2"))
+    launcher2, _ = _tree(runtime2, ckpt_dir, save_every=2, keep_last=2)
+    launcher2.launch()
+    assert not os.path.exists(os.path.join(ckpt_dir, "3"))
+    assert newest_complete_step(ckpt_dir) == 8
+
+
+def test_drain_marker_written_over_complete_periodic_save(tmp_path):
+    """A drain boundary can coincide with a step a periodic save already
+    covered: the emergency rewrite is skipped, but the drain.json marker
+    must still land — the smoke's marker assertion holds at ANY drain
+    step, not just the 4-in-5 that miss a save boundary."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    launcher, _ = _tree(runtime, ckpt_dir, drain_after=3, save_every=3)
+    with pytest.raises(SystemExit) as excinfo:
+        launcher.launch()
+    assert excinfo.value.code == EXIT_DRAINED
+    path = excinfo.value.checkpoint
+    assert os.path.basename(path) == "3"
+    assert is_complete_checkpoint(path)
+    with open(os.path.join(path, "drain.json")) as f:
+        assert json.load(f)["step"] == 3
+
+
+def test_drain_in_checkpointerless_phase_saves_via_registry(tmp_path):
+    """A SIGTERM landing during a phase that owns no Checkpointer (the
+    eval Looper) must still checkpoint: the runtime-wide registry reaches
+    the train phase's Checkpointer — phase-subtree find() alone would
+    come back empty and drop all progress since the last periodic save."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    launcher = rt.Launcher(
+        [
+            rt.Looper(
+                [rt.Dataset(class_data(), batch_size=32, device_cache=False),
+                 module,
+                 rt.Checkpointer(output_dir=ckpt_dir, save_every=1000)],
+                tag="train", progress=False),
+            rt.Looper(
+                [rt.Dataset(class_data(), batch_size=32, device_cache=False),
+                 rt.Module(MLP(in_features=8, num_classes=4, hidden=(16,))),
+                 DrainAt(2)],
+                tag="val", grad_enabled=False, progress=False),
+        ],
+        num_epochs=1, runtime=runtime,
+    )
+    with pytest.raises(SystemExit) as excinfo:
+        launcher.launch()
+    assert excinfo.value.code == EXIT_DRAINED
+    path = excinfo.value.checkpoint
+    assert path is not None and is_complete_checkpoint(path)
+    assert os.path.exists(os.path.join(path, "drain.json"))
+
+
+def test_looper_drain_without_checkpointer_still_exits(tmp_path):
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    launcher = rt.Launcher(
+        [rt.Looper(
+            [rt.Dataset(class_data(), batch_size=32, device_cache=False),
+             module, DrainAt(2)],
+            tag="train", progress=False)],
+        num_epochs=1, runtime=runtime,
+    )
+    with pytest.raises(SystemExit) as excinfo:
+        launcher.launch()
+    assert excinfo.value.code == EXIT_DRAINED
+    assert excinfo.value.checkpoint is None
+
+
+def test_fault_injected_kill_through_real_loop(tmp_path, monkeypatch):
+    """A FaultPlan kill wired through env -> Runtime -> Looper.step_hook:
+    the injector consults the REAL loop path. The kill action is swapped
+    for a recorder (actually SIGKILLing pytest would be rude)."""
+    monkeypatch.setenv("ROCKET_TPU_FAULTS", "kill:step=2")
+    runtime = Runtime(seed=0, project_dir=str(tmp_path))
+    assert runtime.faults is not None
+    died = []
+    runtime.faults._kill = lambda: (_ for _ in ()).throw(
+        KeyboardInterrupt("injected-kill"))
+    runtime.faults._note = lambda *a, **k: died.append(a)
+    module = rt.Module(
+        MLP(in_features=8, num_classes=4, hidden=(16,)),
+        capsules=[rt.Loss(cross_entropy),
+                  rt.Optimizer(optim.adam(), learning_rate=1e-2)],
+    )
+    launcher = rt.Launcher(
+        [rt.Looper(
+            [rt.Dataset(class_data(), batch_size=32, device_cache=False),
+             module],
+            tag="train", progress=False)],
+        num_epochs=1, runtime=runtime,
+    )
+    with pytest.raises(KeyboardInterrupt):
+        launcher.launch()
+    assert len(died) == 1
+
+
+# -- watchdog escalation -> restartable exit ---------------------------------
+
+
+def test_escalation_exit_under_supervision(monkeypatch):
+    from rocket_tpu.obs.telemetry import Telemetry
+
+    exits = []
+    monkeypatch.setattr(os, "_exit", exits.append)
+    telemetry = Telemetry(enabled=True)
+    telemetry.escalation_exit_code = EXIT_WEDGED
+    telemetry._on_stall_escalation("wedged report")
+    assert exits == [EXIT_WEDGED]
+    # Without the supervisor wiring, escalation stays diagnostic-only.
+    exits.clear()
+    telemetry.escalation_exit_code = None
+    telemetry._on_stall_escalation("wedged report")
+    assert exits == []
+
+
+def test_runtime_supervised_env_arms_escalation_exit(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROCKET_TPU_SUPERVISED", "1")
+    previous = signal.getsignal(signal.SIGTERM)
+    try:
+        runtime = Runtime(seed=0, project_dir=str(tmp_path), telemetry=True)
+        assert runtime.supervised
+        assert runtime.telemetry.escalation_exit_code == EXIT_WEDGED
+        # The SIGTERM->drain handler was installed by the Runtime.
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert runtime.drain.requested
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+# -- supervisor control flow -------------------------------------------------
+
+
+def _touch_checkpoint(ckpt_dir, step):
+    path = os.path.join(ckpt_dir, str(step))
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "rng.json"), "w") as f:
+        f.write("{}")
+
+
+class ScriptedRunner:
+    """Generation runner for supervisor unit tests: each entry is either
+    an exit code or a callable(gen, nproc) -> rc run before returning."""
+
+    def __init__(self, script, durations=None, clock=None):
+        self.script = list(script)
+        self.calls = []
+        self.durations = durations or {}
+        self.clock = clock
+
+    def __call__(self, gen, nproc, drain_event, on_poll):
+        self.calls.append((gen, nproc))
+        entry = self.script.pop(0)
+        rc = entry(gen, nproc) if callable(entry) else entry
+        if self.clock is not None:
+            self.clock.advance(self.durations.get(gen, 0.0))
+        on_poll()
+        return rc, [rc] * nproc, {"0": ["tail line"]}
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        # Each read advances a hair so probe throttling (>= 1s apart)
+        # cannot starve the progress observation in tests.
+        self.t += 1.01
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _supervisor(tmp_path, script, nproc=1, policy=None, ckpt_dir=None,
+                clock=None, durations=None):
+    runner = ScriptedRunner(script, durations=durations, clock=clock)
+    sup = Supervisor(
+        nproc, "train.py",
+        policy=policy or RestartPolicy(backoff_base_s=0.0, backoff_max_s=0.0,
+                                       progress_grace_s=1e9),
+        state_dir=str(tmp_path / "state"),
+        ckpt_dir=ckpt_dir,
+        run_generation=runner,
+        sleep=lambda s: None,
+        clock=clock or FakeClock(),
+    )
+    return sup, runner
+
+
+def test_supervisor_restarts_until_completion(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    os.makedirs(ckpt)
+
+    def crash_with_progress(gen, nproc):
+        _touch_checkpoint(ckpt, 5 * (gen + 1))
+        return -9  # SIGKILLed worker
+
+    sup, runner = _supervisor(
+        tmp_path, [crash_with_progress, crash_with_progress, 0],
+        ckpt_dir=ckpt,
+    )
+    rc = sup.run()
+    assert rc == 0
+    assert sup.outcome == "completed"
+    assert sup.restarts == 2
+    assert [g.outcome for g in sup.generations] == [
+        "crashed", "crashed", "completed"]
+    assert all(g.progressed for g in sup.generations[:2])
+    state = json.load(open(os.path.join(str(tmp_path / "state"),
+                                        "supervisor.json")))
+    assert state["outcome"] == "completed" and state["restarts"] == 2
+    assert state["last_ckpt_step"] == 10
+    assert 0.0 <= state["goodput_fraction"] <= 1.0
+
+
+def test_supervisor_crash_loop_refuses_to_thrash(tmp_path):
+    policy = RestartPolicy(crash_loop_threshold=3, backoff_base_s=0.0,
+                           progress_grace_s=1e9, max_restarts=100)
+    sup, runner = _supervisor(tmp_path, [1, 1, 1, 1, 1], policy=policy)
+    rc = sup.run()
+    assert rc == 1
+    assert sup.outcome == "crash_loop"
+    # threshold consecutive no-progress failures -> exactly 3 generations.
+    assert len(sup.generations) == 3
+    # The failing generation's output tail is the supervisor's black box.
+    assert sup.generations[-1].output_tail == {"0": ["tail line"]}
+    state = json.load(open(os.path.join(str(tmp_path / "state"),
+                                        "supervisor.json")))
+    assert state["outcome"] == "crash_loop" and state["rc"] == 1
+
+
+def test_supervisor_restart_budget(tmp_path):
+    policy = RestartPolicy(max_restarts=2, crash_loop_threshold=100,
+                           backoff_base_s=0.0, progress_grace_s=1e9)
+    sup, runner = _supervisor(tmp_path, [7, 7, 7, 7], policy=policy)
+    rc = sup.run()
+    assert rc == 7
+    assert sup.outcome == "restart_budget_exhausted"
+    assert sup.restarts == 2 and len(sup.generations) == 3
+
+
+def test_supervisor_honors_drained_exit(tmp_path):
+    sup, runner = _supervisor(tmp_path, [EXIT_DRAINED])
+    rc = sup.run()
+    assert rc == 0
+    assert sup.outcome == "drained"
+    assert sup.generations[0].outcome == "drained"
+
+
+def test_supervisor_drained_exit_requires_checkpoint_under_probe(tmp_path):
+    """With a --ckpt-dir probe, rc 0 on a drain certifies a durable
+    checkpoint to resume from: a worker exiting the drained code while
+    the probe sees an EMPTY checkpoint dir (checkpointer-less script,
+    every save torn) is drain_failed, not a clean stop an orchestrator
+    would read as state-saved."""
+    ckpt = str(tmp_path / "ckpts")
+    os.makedirs(ckpt)
+    sup, _ = _supervisor(tmp_path, [EXIT_DRAINED], ckpt_dir=ckpt)
+    rc = sup.run()
+    assert rc != 0 and sup.outcome == "drain_failed"
+
+    # With a complete checkpoint on disk the same exit IS certified.
+    _touch_checkpoint(ckpt, 7)
+    sup2, _ = _supervisor(tmp_path, [EXIT_DRAINED], ckpt_dir=ckpt)
+    rc2 = sup2.run()
+    assert rc2 == 0 and sup2.outcome == "drained"
+
+
+def test_supervisor_sigint_drains_then_restores_previous_handler(tmp_path):
+    """First Ctrl-C requests the drain and restores the previous SIGINT
+    disposition (so a second Ctrl-C interrupts hard — the worker-side
+    install_signal_drain contract); SIGTERM stays routed to drain."""
+    sup, _ = _supervisor(tmp_path, [0])
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    try:
+        sup.install_signal_handlers()
+        os.kill(os.getpid(), signal.SIGINT)
+        assert sup.drain_signals == 1
+        assert signal.getsignal(signal.SIGINT) is prev_int
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert sup.drain_signals == 2
+    finally:
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_supervisor_classifies_wedged(tmp_path):
+    policy = RestartPolicy(crash_loop_threshold=2, backoff_base_s=0.0,
+                           progress_grace_s=1e9)
+    sup, runner = _supervisor(tmp_path, [EXIT_WEDGED, EXIT_WEDGED],
+                              policy=policy)
+    rc = sup.run()
+    assert rc != 0
+    assert [g.outcome for g in sup.generations] == ["wedged", "wedged"]
+
+
+def test_supervisor_degrades_topology(tmp_path):
+    """Repeated no-progress failures at one worker count re-resolve the
+    topology: -n shrinks toward min_procs (the surviving mesh)."""
+    policy = RestartPolicy(degrade_after=2, min_procs=1,
+                           crash_loop_threshold=100, max_restarts=100,
+                           backoff_base_s=0.0, progress_grace_s=1e9)
+    sup, runner = _supervisor(tmp_path, [1, 1, 1, 1, 0], nproc=3,
+                              policy=policy)
+    rc = sup.run()
+    assert rc == 0
+    assert [c[1] for c in runner.calls] == [3, 3, 2, 2, 1]
+
+
+def test_supervisor_degrades_to_floor_before_declaring_crash_loop(tmp_path):
+    """With the DEFAULT thresholds (degrade_after=2 < crash_loop=3) a
+    persistently-failing run must walk the topology all the way to
+    min_procs before giving up: degrade is evaluated before the
+    crash-loop verdict and resets the failure streak (re-resolution is
+    the recovery action), so only the floor can declare a crash loop."""
+    policy = RestartPolicy(degrade_after=2, crash_loop_threshold=3,
+                           min_procs=1, max_restarts=100,
+                           backoff_base_s=0.0, progress_grace_s=1e9)
+    sup, runner = _supervisor(tmp_path, [1] * 7, nproc=3, policy=policy)
+    rc = sup.run()
+    assert rc == 1
+    assert sup.outcome == "crash_loop"
+    # 3,3 -> degrade; 2,2 -> degrade; 1,1,1 -> crash loop at the floor.
+    assert [c[1] for c in runner.calls] == [3, 3, 2, 2, 1, 1, 1]
+
+
+def test_supervisor_backoff_is_capped_exponential():
+    policy = RestartPolicy(backoff_base_s=0.5, backoff_factor=2.0,
+                           backoff_max_s=4.0)
+    assert [policy.backoff_s(n) for n in range(1, 6)] == [
+        0.5, 1.0, 2.0, 4.0, 4.0]
+
+
+def test_supervisor_goodput_credits_salvaged_checkpoint_time(tmp_path):
+    """A crashed generation is productive up to its last observed
+    checkpoint advance; a completed generation is productive end-to-end."""
+    ckpt = str(tmp_path / "ckpts")
+    os.makedirs(ckpt)
+    clock = FakeClock()
+
+    def crash_after_ckpt(gen, nproc):
+        _touch_checkpoint(ckpt, 5)
+        return -9
+
+    sup, runner = _supervisor(
+        tmp_path, [crash_after_ckpt, 0], ckpt_dir=ckpt, clock=clock,
+        durations={0: 10.0, 1: 20.0},
+    )
+    rc = sup.run()
+    assert rc == 0
+    gen0, gen1 = sup.generations
+    assert gen0.productive_s > 0.0          # salvage credited
+    assert gen0.productive_s <= gen0.duration_s
+    assert gen1.productive_s == pytest.approx(gen1.duration_s)
+    summary = sup.summary()
+    assert 0.0 < summary["goodput_fraction"] <= 1.0
+
+
+def test_supervisor_drain_event_stops_the_loop(tmp_path):
+    """A drain signal that cannot be honored by an actual worker drain is
+    never certified clean: arriving while workers crash -> drain_failed
+    (non-zero), and arriving during the inter-generation backoff (the
+    last generation CRASHED, no drain checkpoint exists) -> the same
+    drain_failed verdict, not a rc-0 "drained" that an orchestrator
+    would read as durably-saved state."""
+    sup, runner = _supervisor(tmp_path, [1])
+    sup.request_drain("SIGTERM")
+    rc = sup.run()
+    assert rc != 0 and sup.outcome == "drain_failed"
+
+    sup2, runner2 = _supervisor(tmp_path, [1, 0])
+    sup2._sleep = lambda s: sup2._drain_event.set()  # SIGTERM mid-backoff
+    rc2 = sup2.run()
+    assert rc2 != 0 and sup2.outcome == "drain_failed"
+    # The scripted second generation never ran — the drain stopped the loop.
+    assert len(sup2.generations) == 1
+
+
+def test_supervisor_coord_error_not_counted_as_crash_loop(tmp_path):
+    """Fast coordinator bind/connect failures (the runner's optional
+    fourth return element, fed by WorkerGroup.coord_error) are
+    infrastructure noise: they must not feed the degrade/crash-loop
+    counters — only the restart budget bounds them."""
+
+    class CoordErrorRunner(ScriptedRunner):
+        def __call__(self, gen, nproc, drain_event, on_poll):
+            rc, codes, tail = super().__call__(gen, nproc, drain_event,
+                                               on_poll)
+            return rc, codes, tail, rc != 0
+
+    runner = CoordErrorRunner([1, 1, 1, 1, 0])
+    policy = RestartPolicy(backoff_base_s=0.0, backoff_max_s=0.0,
+                           progress_grace_s=1e9, crash_loop_threshold=3,
+                           degrade_after=2, min_procs=1)
+    sup = Supervisor(
+        2, "train.py", policy=policy, state_dir=str(tmp_path / "state"),
+        run_generation=runner, sleep=lambda s: None, clock=FakeClock(),
+    )
+    rc = sup.run()
+    # Four coordinator failures would have tripped degrade_after=2 (to
+    # nproc=1) and crash_loop_threshold=3; instead every generation ran
+    # at the full count and the run completed.
+    assert rc == 0 and sup.outcome == "completed"
+    assert [n for _, n in runner.calls] == [2, 2, 2, 2, 2]
+    assert all(g.coord_error for g in sup.generations[:4])
+    assert not sup.generations[-1].coord_error
+
+
+def test_supervisor_ckpt_probe_overrides_duration_heuristic(tmp_path):
+    """With a --ckpt-dir probe, durable checkpoint advance is the ONLY
+    progress evidence: a deterministic crasher whose startup outlives
+    progress_grace_s must still trip the crash-loop detector instead of
+    thrashing through the whole restart budget."""
+    ckpt = str(tmp_path / "ckpts")
+    os.makedirs(ckpt)
+    clock = FakeClock()
+    policy = RestartPolicy(backoff_base_s=0.0, backoff_max_s=0.0,
+                           progress_grace_s=5.0, crash_loop_threshold=3,
+                           max_restarts=50)
+    sup, runner = _supervisor(
+        tmp_path, [1, 1, 1, 1], policy=policy, ckpt_dir=ckpt, clock=clock,
+        durations={0: 60.0, 1: 60.0, 2: 60.0},  # each gen outlives the grace
+    )
+    rc = sup.run()
+    assert rc != 0 and sup.outcome == "crash_loop"
+    assert len(sup.generations) == 3
+    assert not any(g.progressed for g in sup.generations)
+
+
+# -- checkpoint-completeness scan -------------------------------------------
+
+
+def test_complete_checkpoint_scan(tmp_path):
+    root = str(tmp_path)
+    assert newest_complete_step(root) is None
+    assert newest_complete_step(None) is None
+    _touch_checkpoint(root, 4)
+    _touch_checkpoint(root, 9)
+    assert newest_complete_step(root) == 9
+    # A model dir whose index references a missing shard file is torn.
+    torn = os.path.join(root, "12", "model_0")
+    os.makedirs(torn)
+    with open(os.path.join(root, "12", "rng.json"), "w") as f:
+        f.write("{}")
+    with open(os.path.join(torn, "index.json"), "w") as f:
+        json.dump({"params/w": {"kind": "array", "chunks": [
+            {"file": "shard_p0.npz", "key": "k", "index": [[0, 1]]}]}}, f)
+    assert not is_complete_checkpoint(os.path.join(root, "12"))
+    assert newest_complete_step(root) == 9
+
+
+def test_obs_report_renders_supervisor_json(tmp_path, capsys):
+    from rocket_tpu.obs.__main__ import main as obs_main
+
+    doc = {
+        "outcome": "completed", "restarts": 1, "drain_events": 0,
+        "goodput_fraction": 0.83, "productive_wall_s": 10.0,
+        "total_wall_s": 12.0,
+        "generations": [
+            {"gen": 0, "nproc": 1, "outcome": "crashed", "duration_s": 2.0,
+             "productive_s": 0.5, "rc": -9, "ckpt_step": 5},
+            {"gen": 1, "nproc": 1, "outcome": "completed", "duration_s": 10.0,
+             "productive_s": 10.0, "rc": 0, "ckpt_step": 40},
+        ],
+    }
+    path = tmp_path / "supervisor.json"
+    path.write_text(json.dumps(doc))
+    assert obs_main(["report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "supervisor: outcome=completed" in out
+    assert "goodput_fraction=0.83" in out
+    assert "crashed" in out and "completed" in out
