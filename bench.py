@@ -824,6 +824,66 @@ def _sched_calibration(results):
     return entries
 
 
+#: Tuned-kernel config tables the offline autotuner maintains
+#: (``python -m rocket_tpu.tune --update-table``).
+TUNE_CONFIGS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "rocket_tpu", "tune", "configs",
+)
+
+
+def tune_summary(configs_dir=TUNE_CONFIGS_DIR):
+    """Tuned-vs-default kernel config record for BENCH_DETAIL.json.
+
+    Per tunable kernel: the checked-in table's entries — each carries
+    its (device kind, shape bucket, dtype) key and the tuner-measured
+    ``speedup``/``tuned_us``/``default_us`` — so tuned-vs-default
+    speedup is tracked per kernel per device kind round-over-round. An
+    empty table (n_entries 0) means the search found no win for that
+    kernel yet and every call runs the hand-picked default.
+    ``device_kind`` is THIS run's device, so the record says whether the
+    measured throughput above could have hit the table at all. Best
+    effort: None on any failure — emission must never die on tuning."""
+    try:
+        from rocket_tpu import tune
+
+        summary = tune.tables_summary(configs_dir)
+        if summary is None:
+            return None
+        summary["device_kind"] = jax.devices()[0].device_kind
+        summary["table_device_kinds"] = sorted({
+            entry.get("device_kind")
+            for kernel in summary["kernels"].values()
+            for entry in kernel["entries"]
+            if entry.get("device_kind")
+        })
+        return summary
+    except Exception as exc:  # noqa: BLE001 — best-effort, like the audits
+        log(f"bench: tune_summary failed: {exc!r}")
+        return None
+
+
+def _reset_tune_provenance():
+    """Best-effort: clear the tune lookup log before a config runs."""
+    try:
+        from rocket_tpu import tune
+
+        tune.reset_lookup_log()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _tune_provenance():
+    """The deduplicated kernel-config lookups the config just traced
+    (table hit vs default fallback + the resolved entry key), or None."""
+    try:
+        from rocket_tpu import tune
+
+        return tune.lookup_log_summary() or None
+    except Exception:  # noqa: BLE001
+        return None
+
+
 #: Serving-budget directory the serve auditor maintains
 #: (``python -m rocket_tpu.analysis serve --update-budgets``).
 SERVE_BUDGETS_DIR = os.path.join(
@@ -1231,6 +1291,13 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
         # Statically-audited numerics next to the measured throughput:
         # fp32-bytes fraction of the traced step + cast counts per target.
         detail["prec_audit"] = prec
+    tune_rec = tune_summary(TUNE_CONFIGS_DIR)
+    if tune_rec is not None:
+        # Tuned-kernel config tables (rocket_tpu.tune) next to the
+        # throughput they shape: per-kernel entries with the tuner's
+        # measured tuned-vs-default speedup per device kind, plus this
+        # run's device kind so table applicability is explicit.
+        detail["tune"] = tune_rec
     sched = sched_audit_summary(results, SCHED_BUDGETS_DIR)
     if sched is not None:
         # Predicted step-time attribution (compute/memory/exposed-comm)
@@ -1369,7 +1436,15 @@ def main():
         log(f"bench: {name} ...")
         t0 = time.time()
         try:
+            _reset_tune_provenance()
             results[name] = BENCHES[name]()
+            prov = _tune_provenance()
+            if prov is not None:
+                # Which kernel configs this config actually resolved
+                # (table hit vs default fallback, with the entry key) —
+                # future perf-trajectory comparisons know which kernels
+                # were tuned when this number was measured.
+                results[name]["kernel_configs"] = prov
             if name in HISTORY and "value" in results[name]:
                 # Round-over-round continuity, mean-vs-mean (ask #8).
                 results[name]["history"] = dict(

@@ -25,8 +25,10 @@ fake-mesh AOT compile the SPMD auditor already does:
    *schedulable* exposure (independent compute existed to hide it) —
    the RKT501 signal;
 5. pallas_call block shapes are collected from the traced jaxpr (the
-   kernels trace abstractly on any backend) and checked against the
-   device VMEM budget and tile alignment (RKT504).
+   kernels trace abstractly on any backend, with the tuned-config
+   lookup pinned to the TARGET device kind so table entries resolve as
+   they would on the audited hardware) and checked against the device
+   VMEM budget and tile alignment (RKT504).
 
 The predicted numbers are a COST MODEL, not a clock: good enough to
 rank schedules, attribute time, and gate regressions (RKT506 budgets,
@@ -911,7 +913,15 @@ def audit_schedule(
     findings: list[Finding] = []
     report = SchedAuditReport(label=label)
 
-    report.pallas = collect_pallas_facts(step_fn, variables, batch)
+    # Trace under the audited target's device kind so the tuned-config
+    # lookup (`rocket_tpu.tune.get_config`) resolves the block shapes
+    # that would ACTUALLY run there — RKT504 then audits the tuned
+    # table's configs, not the hand-picked defaults the audit host (a
+    # CPU with no table entries) would fall back to.
+    from rocket_tpu.tune import priced_device_kind
+
+    with priced_device_kind(device_kind):
+        report.pallas = collect_pallas_facts(step_fn, variables, batch)
     findings.extend(check_pallas(
         report.pallas, spec.vmem_bytes, label=label
     ))

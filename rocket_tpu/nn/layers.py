@@ -160,7 +160,7 @@ class GlobalAvgPool2D(Layer):
         return jnp.mean(x, axis=(1, 2)), variables["state"]
 
 
-def _bn_train_impl(x, scale, bias, eps):
+def _bn_train_impl(x, scale, bias, eps, moments=None):
     axes = tuple(range(x.ndim - 1))
     xf = x.astype(jnp.float32)
     # One-pass statistics: var = E[x^2] - E[x]^2 lets XLA compute both
@@ -176,7 +176,25 @@ def _bn_train_impl(x, scale, bias, eps):
     # cost two ~1us-latency collectives per BN layer per pass — sched_audit
     # RKT501/RKT502 flagged the pairs on the dp_resnet_1x8 target (105
     # tiny all-reduces/step).
-    stats = jnp.mean(jnp.stack([xf, jnp.square(xf)], axis=-1), axis=axes)
+    #
+    # The moment form is tunable (tune kernel "fused_bn": "stacked" is
+    # the measured default; "separate" keeps the two reductions XLA can
+    # sometimes fuse differently on single-device conv stacks) — both
+    # compute the same two means, so outputs are parity-equal.
+    if moments is None:
+        from rocket_tpu.tune import get_config
+
+        config = get_config(
+            "fused_bn", shape={"c": x.shape[-1]}, dtype=x.dtype
+        )
+        moments = (config or {}).get("moments", "stacked")
+    if moments == "separate":
+        stats = jnp.stack(
+            [jnp.mean(xf, axis=axes), jnp.mean(jnp.square(xf), axis=axes)],
+            axis=-1,
+        )
+    else:
+        stats = jnp.mean(jnp.stack([xf, jnp.square(xf)], axis=-1), axis=axes)
     mean = stats[..., 0]
     var = jnp.maximum(stats[..., 1] - jnp.square(mean), 0.0)
     inv = jax.lax.rsqrt(var + eps)
@@ -184,8 +202,8 @@ def _bn_train_impl(x, scale, bias, eps):
     return y, stats, mean, inv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _bn_train(x, scale, bias, eps):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, bias, eps, moments=None):
     """Train-mode batchnorm with a FUSED backward: autodiff of the stacked
     forward still emits three per-channel reductions in the backward
     (d_bias, d_scale and the dmean/dvar chain) — three ~1us cross-replica
@@ -195,16 +213,16 @@ def _bn_train(x, scale, bias, eps):
     follow. Returns ``(y, stats)``; ``stats`` (C, 2) raw moments feed the
     running-average state ONLY (callers stop_gradient them — the backward
     ignores their cotangent)."""
-    y, stats, _, _ = _bn_train_impl(x, scale, bias, eps)
+    y, stats, _, _ = _bn_train_impl(x, scale, bias, eps, moments)
     return y, stats
 
 
-def _bn_train_fwd(x, scale, bias, eps):
-    y, stats, mean, inv = _bn_train_impl(x, scale, bias, eps)
+def _bn_train_fwd(x, scale, bias, eps, moments=None):
+    y, stats, mean, inv = _bn_train_impl(x, scale, bias, eps, moments)
     return (y, stats), (x, scale, mean, inv)
 
 
-def _bn_train_bwd(eps, res, cts):
+def _bn_train_bwd(eps, moments, res, cts):
     dy, _ = cts  # stats feed only the stop_gradient'd EMA state
     x, scale, mean, inv = res
     axes = tuple(range(x.ndim - 1))

@@ -31,6 +31,22 @@ from rocket_tpu.nn.module import Layer
 __all__ = ["MoE"]
 
 
+def _gmm_tiling(m: int, k: int, n: int, dtype) -> tuple:
+    """megablox gmm tile triple: the tuned-config table for this device
+    kind / (m, k, n) bucket / dtype (tune kernel ``moe_gmm``), falling
+    back to the hand-picked 512s — the measured sweet spot at bench-MoE
+    shapes (docs/performance.md: 512-wide within ~5% of dense per row,
+    the 128 default ~2x slower). Tiles are clamped to the operand dims
+    either way."""
+    from rocket_tpu.tune import get_config
+
+    config = get_config(
+        "moe_gmm", shape={"m": m, "k": k, "n": n}, dtype=dtype
+    ) or {"tile_m": 512, "tile_k": 512, "tile_n": 512}
+    return (min(config["tile_m"], m), min(config["tile_k"], k),
+            min(config["tile_n"], n))
+
+
 def _grouped_matmul(lhs, rhs, group_sizes):
     """``lhs`` rows grouped by ``group_sizes`` times per-group ``rhs[g]``.
 
@@ -58,8 +74,8 @@ def _grouped_matmul(lhs, rhs, group_sizes):
     if on_tpu and k % 128 == 0 and n % 128 == 0 and m % 8 == 0:
         from jax.experimental.pallas.ops.tpu.megablox.ops import gmm
 
-        tiling = (min(512, m), min(512, k), min(512, n))
-        return gmm(lhs, rhs, group_sizes, lhs.dtype, tiling)
+        return gmm(lhs, rhs, group_sizes, lhs.dtype,
+                   _gmm_tiling(m, k, n, lhs.dtype))
     return jax.lax.ragged_dot(
         lhs.astype(jnp.float32), rhs.astype(jnp.float32), group_sizes,
         preferred_element_type=jnp.float32,

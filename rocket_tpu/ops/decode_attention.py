@@ -62,20 +62,22 @@ def decode_attention_supported(
 
 
 def _kernel(pos_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref,
-            o_ref, ko_ref, vo_ref, *, h_kv, g, d, scale):
+            o_ref, ko_ref, vo_ref, *, h_kv, g, d, scale, rows):
     pos = pos_ref[0]
     # In-place cache row write. Mosaic needs >= 8 sublanes per block, so
-    # the output block is the 8-row tile containing `pos` (ko/vo alias
-    # kc/vc and the BlockSpec maps this cell to tile pos//8): read the
-    # tile, replace row pos%8, write it back. All ops kept 2D per head —
-    # 3D broadcasts hit Mosaic's "unsupported shape cast".
-    base = (pos // 8) * 8
+    # the output block is the `rows`-row tile containing `pos` (ko/vo
+    # alias kc/vc and the BlockSpec maps this cell to tile pos//rows):
+    # read the tile, replace row pos%rows, write it back. `rows` is the
+    # tunable write-back tile height (tune kernel "decode_attention";
+    # default 8, the Mosaic minimum). All ops kept 2D per head — 3D
+    # broadcasts hit Mosaic's "unsupported shape cast".
+    base = (pos // rows) * rows
     rowmask = (
-        jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) == pos % 8
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) == pos % rows
     )
     for h in range(h_kv):
-        k_tile = kc_ref[0, h, pl.ds(base, 8), :]    # (8, D)
-        v_tile = vc_ref[0, h, pl.ds(base, 8), :]
+        k_tile = kc_ref[0, h, pl.ds(base, rows), :]    # (rows, D)
+        v_tile = vc_ref[0, h, pl.ds(base, rows), :]
         ko_ref[0, h] = jnp.where(rowmask, kn_ref[0, h:h + 1, :], k_tile)
         vo_ref[0, h] = jnp.where(rowmask, vn_ref[0, h:h + 1, :], v_tile)
 
@@ -115,6 +117,7 @@ def decode_attention(
     v_cache: jax.Array,
     pos,
     interpret: Optional[bool] = None,
+    rows: Optional[int] = None,
 ):
     """One fused decode-attention step.
 
@@ -147,6 +150,23 @@ def decode_attention(
         )
     g = hq // h_kv
     scale = 1.0 / (d ** 0.5)
+    if rows is None:
+        # Tunable write-back tile height: the aliased cache tile the
+        # kernel rewrites around `pos` (tune kernel "decode_attention";
+        # no table entry -> 8, the Mosaic sublane minimum — the
+        # pre-tuner behavior).
+        from rocket_tpu.tune import get_config
+
+        config = get_config(
+            "decode_attention",
+            shape={"t": t, "d": d, "hkv": h_kv}, dtype=k_cache.dtype,
+        )
+        rows = (config or {}).get("rows", 8)
+    if rows % 8 or t % rows:
+        raise ValueError(
+            f"decode_attention: rows={rows} must be a multiple of 8 "
+            f"dividing T_max={t}"
+        )
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
 
@@ -162,21 +182,22 @@ def decode_attention(
         ],
         out_specs=[
             pl.BlockSpec((1, hq, d), lambda i, pos_ref: (i, 0, 0)),
-            # The written cache tile (8 rows containing `pos`): dynamic
-            # block index from the prefetched scalar — the rest of the
-            # cache rides the aliasing.
+            # The written cache tile (`rows` rows containing `pos`):
+            # dynamic block index from the prefetched scalar — the rest
+            # of the cache rides the aliasing.
             pl.BlockSpec(
-                (1, h_kv, 8, d),
-                lambda i, pos_ref: (i, 0, pos_ref[0] // 8, 0),
+                (1, h_kv, rows, d),
+                lambda i, pos_ref: (i, 0, pos_ref[0] // rows, 0),
             ),
             pl.BlockSpec(
-                (1, h_kv, 8, d),
-                lambda i, pos_ref: (i, 0, pos_ref[0] // 8, 0),
+                (1, h_kv, rows, d),
+                lambda i, pos_ref: (i, 0, pos_ref[0] // rows, 0),
             ),
         ],
     )
     out, k_out, v_out = pl.pallas_call(
-        functools.partial(_kernel, h_kv=h_kv, g=g, d=d, scale=scale),
+        functools.partial(_kernel, h_kv=h_kv, g=g, d=d, scale=scale,
+                          rows=rows),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, d), q.dtype),

@@ -52,11 +52,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
+    "DEFAULT_BLOCK",
     "flash_attention",
     "flash_attention_qkv",
     "flash_attention_qkv_sharded",
     "in_manual_axes",
     "pick_block",
+    "resolve_tuned_blocks",
     "shardable_axes",
 ]
 
@@ -163,7 +165,24 @@ def _head_block(h: int) -> int:
     return 2 if h % 2 == 0 else 1
 
 
+def _check_causal_blocks(block_q: int, block_k: int, causal: bool,
+                         where: str) -> None:
+    """Fail FAST on the diagonal-alignment constraint: causal masking
+    runs only on diagonal blocks, which is correct ONLY for aligned
+    square blocks (``block_q == block_k``). An unaligned pair would
+    silently mis-mask scores — an illegal tuner candidate must raise
+    here, at the kernel entry, not return wrong attention output."""
+    if causal and block_q != block_k:
+        raise ValueError(
+            f"{where}: causal diagonal-block masking requires "
+            f"block_q == block_k (got block_q={block_q}, "
+            f"block_k={block_k}). Use equal blocks, or causal=False for "
+            "asymmetric blocking."
+        )
+
+
 def _fwd(qkv, *, causal, block_q, block_k, interpret):
+    _check_causal_blocks(block_q, block_k, causal, "flash_attention._fwd")
     _, b, h, t, d = qkv.shape
     scale2 = _LOG2E / math.sqrt(d)
     nq, nk = t // block_q, t // block_k
@@ -277,7 +296,9 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, dout):
+def _bwd(causal, blocks, interpret, res, dout):
+    block_q, block_k = blocks[2], blocks[3]
+    _check_causal_blocks(block_q, block_k, causal, "flash_attention._bwd")
     qkv, out, lse = res
     _, b, h, t, d = qkv.shape
     scale = 1.0 / math.sqrt(d)
@@ -344,18 +365,18 @@ def _bwd(causal, block_q, block_k, interpret, res, dout):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def _flash(qkv, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash(qkv, causal, blocks, interpret):
     out, _ = _fwd(
-        qkv, causal=causal, block_q=block_q, block_k=block_k,
+        qkv, causal=causal, block_q=blocks[0], block_k=blocks[1],
         interpret=interpret,
     )
     return out
 
 
-def _flash_fwd(qkv, causal, block_q, block_k, interpret):
+def _flash_fwd(qkv, causal, blocks, interpret):
     out, lse = _fwd(
-        qkv, causal=causal, block_q=block_q, block_k=block_k,
+        qkv, causal=causal, block_q=blocks[0], block_k=blocks[1],
         interpret=interpret,
     )
     return out, (qkv, out, lse)
@@ -373,17 +394,67 @@ def _resolve_blocks(t: int, causal: bool, block_q: int, block_k: int):
             "supported block size (128); use the XLA path for ragged shapes."
         )
     if causal:
-        # Diagonal-block masking assumes aligned square blocks.
+        # Diagonal-block masking needs aligned square blocks (the kernel
+        # entry raises otherwise — _check_causal_blocks).
         bq = bk = min(bq, bk)
     return bq, bk
+
+
+#: The hand-picked block size the tuned-table lookup falls back to —
+#: the measured best at bench shapes (docs/performance.md: 512x512 best,
+#: 256-variants 10-18% worse).
+DEFAULT_BLOCK = 512
+
+
+def resolve_tuned_blocks(
+    t: int, d: int, h: int, h_kv: int, dtype, causal: bool,
+    block_q, block_k, bwd_block_q, bwd_block_k,
+) -> tuple:
+    """(block_q, block_k, bwd_block_q, bwd_block_k) with ``None`` args
+    resolved through the tuned-config table (`rocket_tpu.tune`,
+    kernels ``flash_fwd``/``flash_bwd``) and today's defaults as the
+    fallback: fwd ``DEFAULT_BLOCK``; bwd the RESOLVED fwd blocks (the
+    pre-tuner behavior — one block pair threaded through both passes).
+    Explicit arguments always win (callers pin blocks in tests and
+    A/Bs). All four are then clamped/validated by `_resolve_blocks`."""
+    shape = {"t": t, "d": d, "h": h, "h_kv": h_kv, "causal": causal}
+    fwd_pinned = block_q is not None and block_k is not None
+    if not fwd_pinned:
+        from rocket_tpu.tune import get_config
+
+        config = get_config("flash_fwd", shape=shape, dtype=dtype) or {}
+        if block_q is None:
+            block_q = config.get("block_q", DEFAULT_BLOCK)
+        if block_k is None:
+            block_k = config.get("block_k", DEFAULT_BLOCK)
+    bq, bk = _resolve_blocks(t, causal, block_q, block_k)
+    if bwd_block_q is None or bwd_block_k is None:
+        # A caller that pinned the forward blocks gets the pre-tuner
+        # behavior for an unpinned backward — the SAME blocks, no table
+        # consultation: pinned A/Bs and repro tests must run exactly the
+        # blocks they name in both passes.
+        if fwd_pinned:
+            config = {}
+        else:
+            from rocket_tpu.tune import get_config
+
+            config = get_config("flash_bwd", shape=shape, dtype=dtype) or {}
+        if bwd_block_q is None:
+            bwd_block_q = config.get("block_q", bq)
+        if bwd_block_k is None:
+            bwd_block_k = config.get("block_k", bk)
+    bbq, bbk = _resolve_blocks(t, causal, bwd_block_q, bwd_block_k)
+    return bq, bk, bbq, bbk
 
 
 def flash_attention_qkv(
     qkv: jax.Array,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on a stacked (3, B, H, T, D) q/k/v array.
 
@@ -391,17 +462,26 @@ def flash_attention_qkv(
     stacked operand costs one layout copy where three separate ones cost
     six. Returns (B, H, T, D). Differentiable (custom VJP, fused one-pass
     backward).
+
+    Block sizes default to the tuned-config table for this device kind /
+    shape bucket / dtype (``rocket_tpu.tune``), falling back to the
+    hand-picked 512s when no entry matches; the backward pass may run
+    its own tuned blocks (``flash_bwd`` table) independent of the
+    forward's. Explicit arguments override the table.
     """
     if qkv.ndim != 5 or qkv.shape[0] != 3:
         raise ValueError(
             f"flash_attention_qkv: expected stacked (3, B, H, T, D), got "
             f"{qkv.shape}; for separate q/k/v use flash_attention()."
         )
-    t = qkv.shape[3]
-    block_q, block_k = _resolve_blocks(t, causal, block_q, block_k)
+    _, _, h, t, d = qkv.shape
+    blocks = resolve_tuned_blocks(
+        t, d, h, h, qkv.dtype, causal,
+        block_q, block_k, bwd_block_q, bwd_block_k,
+    )
     if interpret is None:
         interpret = _interpret_default()
-    return _flash(qkv, causal, block_q, block_k, interpret)
+    return _flash(qkv, causal, blocks, interpret)
 
 
 def in_manual_axes(axis_names) -> bool:
@@ -444,8 +524,8 @@ def flash_attention_qkv_sharded(
     mesh,
     batch_axes=("data",),
     head_axis: str = "model",
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention composed with a multi-device mesh via ``shard_map``.
@@ -496,8 +576,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise (flash) attention for (B, H, T, D) operands.

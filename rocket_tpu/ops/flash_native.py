@@ -59,7 +59,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from rocket_tpu.ops.flash_attention import pick_block
+from rocket_tpu.ops.flash_attention import (
+    _check_causal_blocks,
+    resolve_tuned_blocks,
+)
 
 __all__ = [
     "flash_fused",
@@ -200,6 +203,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
 
 def _fwd(q_arr, k_arr, v_arr, *, h, h_kv, d, kb, q_off, k_off, v_off,
          causal, block_q, block_k, interpret):
+    _check_causal_blocks(block_q, block_k, causal, "flash_native._fwd")
     b, t, _ = q_arr.shape
     g = h // h_kv
     scale2 = _LOG2E / math.sqrt(d)
@@ -415,6 +419,7 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
                 q_off, k_off, v_off, causal, block_q, block_k, interpret,
                 dq_split=None):
     """Shared backward body -> (dq (B,T,HqD), dk (B,T,HkvD), dv)."""
+    _check_causal_blocks(block_q, block_k, causal, "flash_native._bwd")
     b, t, _ = q_arr.shape
     g = h // h_kv
     scale = 1.0 / math.sqrt(d)
@@ -552,53 +557,40 @@ def _bwd_arrays(q_arr, k_arr, v_arr, out, lse, dout, *, h, h_kv, d, kb,
     return dq, dk, dv
 
 
-def _resolve_blocks(t: int, causal: bool, block_q: int, block_k: int):
-    bq = pick_block(t, min(block_q, t))
-    bk = pick_block(t, min(block_k, t))
-    if bq is None or bk is None:
-        raise ValueError(
-            f"flash_native: seq len {t} must be a multiple of a supported "
-            "block size (128); use the XLA path for ragged shapes."
-        )
-    if causal:
-        bq = bk = min(bq, bk)
-    return bq, bk
-
-
 # --------------------------------------------------------------------------
 # public op: fused single-operand MHA (the GPT-2 hot path)
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _flash_fused(fused, h, d, causal, block_q, block_k, interpret, dq_split):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def _flash_fused(fused, h, d, causal, blocks, interpret, dq_split):
     out, _ = _fwd(
         fused, fused, fused, h=h, h_kv=h, d=d, kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        causal=causal, block_q=blocks[0], block_k=blocks[1],
+        interpret=interpret,
     )
     return out
 
 
-def _flash_fused_fwd(fused, h, d, causal, block_q, block_k, interpret,
-                     dq_split):
+def _flash_fused_fwd(fused, h, d, causal, blocks, interpret, dq_split):
     out, lse = _fwd(
         fused, fused, fused, h=h, h_kv=h, d=d, kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        causal=causal, block_q=blocks[0], block_k=blocks[1],
+        interpret=interpret,
     )
     return out, (fused, out, lse)
 
 
-def _flash_fused_bwd(h, d, causal, block_q, block_k, interpret, dq_split,
-                     res, dout):
+def _flash_fused_bwd(h, d, causal, blocks, interpret, dq_split, res, dout):
     fused, out, lse = res
     dq, dk, dv = _bwd_arrays(
         fused, fused, fused, out, lse, dout, h=h, h_kv=h, d=d,
         kb=_fused_kb(h, d),
         q_off=0, k_off=h * d, v_off=2 * h * d,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
-        dq_split=dq_split,
+        causal=causal, block_q=blocks[2], block_k=blocks[3],
+        interpret=interpret, dq_split=dq_split,
     )
     return (jnp.concatenate([dq, dk, dv], axis=-1),)
 
@@ -610,10 +602,12 @@ def flash_fused(
     fused: jax.Array,
     num_heads: int,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     dq_split: Optional[bool] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention directly on the fused QKV projection output.
 
@@ -623,6 +617,12 @@ def flash_fused(
     the ONE operand. Returns (B, T, H*D), ready for the output projection.
     Differentiable (custom VJP, one-pass fused backward producing the
     (B, T, 3*H*D) cotangent).
+
+    Block sizes left ``None`` resolve through the tuned-config table
+    (``rocket_tpu.tune`` — ``flash_fwd``/``flash_bwd`` entries for this
+    device kind / shape bucket / dtype), falling back to the hand-picked
+    512s with the backward riding the forward's blocks; explicit values
+    always win.
 
     ``dq_split``: backward dq strategy — None (default) picks by the
     partial-buffer footprint (``_DQ_PARTIALS_MAX_BYTES``); False forces
@@ -636,7 +636,10 @@ def flash_fused(
             f"flash_fused: feature dim {f} is not 3*H*D for H={num_heads}"
         )
     d = f // (3 * num_heads)
-    block_q, block_k = _resolve_blocks(t, causal, block_q, block_k)
+    blocks = resolve_tuned_blocks(
+        t, d, num_heads, num_heads, fused.dtype, causal,
+        block_q, block_k, bwd_block_q, bwd_block_k,
+    )
     if interpret is None:
         interpret = _interpret_default()
     if _fused_kb(num_heads, d) is None:
@@ -646,11 +649,12 @@ def flash_fused(
         hd = num_heads * d
         return flash_bthd(
             fused[..., :hd], fused[..., hd:2 * hd], fused[..., 2 * hd:],
-            num_heads, causal=causal, block_q=block_q, block_k=block_k,
+            num_heads, causal=causal, block_q=blocks[0], block_k=blocks[1],
             interpret=interpret, dq_split=dq_split,
+            bwd_block_q=blocks[2], bwd_block_k=blocks[3],
         )
     return _flash_fused(
-        fused, num_heads, d, causal, block_q, block_k, interpret, dq_split
+        fused, num_heads, d, causal, blocks, interpret, dq_split
     )
 
 
@@ -690,7 +694,7 @@ def _flash_bthd_bwd(h, h_kv, d, causal, blocks, interpret, dq_split,
     return _bwd_arrays(
         q2, k2, v2, out, lse, dout, h=h, h_kv=h_kv, d=d, kb=kb,
         q_off=0, k_off=0, v_off=0,
-        causal=causal, block_q=blocks[0], block_k=blocks[1],
+        causal=causal, block_q=blocks[2], block_k=blocks[3],
         interpret=interpret, dq_split=dq_split,
     )
 
@@ -705,10 +709,12 @@ def flash_bthd(
     num_heads: int,
     num_kv_heads: Optional[int] = None,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     dq_split: Optional[bool] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on feature-major (B, T, H*D) operands.
 
@@ -733,12 +739,15 @@ def flash_bthd(
     if v2.shape != k2.shape:
         raise ValueError("flash_bthd: k and v must share one shape")
     d = f // num_heads
-    block_q, block_k = _resolve_blocks(t, causal, block_q, block_k)
+    blocks = resolve_tuned_blocks(
+        t, d, num_heads, num_kv_heads, q2.dtype, causal,
+        block_q, block_k, bwd_block_q, bwd_block_k,
+    )
     if interpret is None:
         interpret = _interpret_default()
     return _flash_bthd(
         q2, k2, v2, num_heads, num_kv_heads, d, causal,
-        (block_q, block_k), interpret, dq_split,
+        blocks, interpret, dq_split,
     )
 
 
@@ -750,8 +759,8 @@ def flash_fused_sharded(
     mesh,
     batch_axes=("data",),
     head_axis: str = "model",
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """:func:`flash_fused` composed with a multi-device mesh.
@@ -813,8 +822,8 @@ def flash_bthd_sharded(
     mesh,
     batch_axes=("data",),
     head_axis: str = "model",
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """:func:`flash_bthd` composed with a multi-device mesh via shard_map.

@@ -109,6 +109,24 @@ def paged_attention(q, k_new, v_new, k_pages, v_pages, block_table,
     if hq % h_kv:
         raise ValueError(f"paged_attention: Hq {hq} not a multiple of Hkv {h_kv}")
     g = hq // h_kv
+    # Tunable surface (tune kernel "paged_decode"): the XLA gather path
+    # is the only variant today; the axis gains candidates when the
+    # VMEM-streaming pallas kernel lands behind this signature (module
+    # docstring). The lookup also records serving-path config provenance
+    # for BENCH_DETAIL.
+    from rocket_tpu.tune import get_config
+
+    config = get_config(
+        "paged_decode",
+        shape={"bl": int(k_pages.shape[1]), "d": d, "hkv": h_kv},
+        dtype=k_pages.dtype,
+    )
+    variant = (config or {}).get("variant", "gather")
+    if variant != "gather":
+        raise ValueError(
+            f"paged_attention: unknown tuned variant {variant!r} — the "
+            "table is ahead of the implementation"
+        )
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, block_table, positions, valid, k_new, v_new
     )

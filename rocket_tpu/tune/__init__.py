@@ -1,0 +1,61 @@
+"""rocket_tpu.tune — search-driven pallas launch-config autotuning.
+
+Three pieces (ROADMAP item 2, in the CUDA-L1/AutoKernel lineage of
+search beating hand-picked kernel configs):
+
+* **TuneSpace** (:mod:`~rocket_tpu.tune.space`): the declarative legal
+  config set per tunable kernel — flash attention fwd/bwd, decode
+  attention, paged decode, MoE gmm tiling, fused BN — with tile/VMEM/
+  diagonal-alignment legality shared by the tuner and the CI gate.
+* **Table + runtime lookup** (:mod:`~rocket_tpu.tune.table`):
+  checked-in JSON tables (``rocket_tpu/tune/configs/*.json``) keyed
+  ``(device kind, shape bucket, dtype)`` with longest-prefix device
+  matching; :func:`get_config` is what the kernels call at trace time,
+  falling back to today's hand-picked defaults when nothing matches —
+  an absent/empty table is behavior-identical to an untuned checkout.
+* **Offline tuner** (:mod:`~rocket_tpu.tune.tuner`, CLI
+  ``python -m rocket_tpu.tune``): sweeps legal candidates on a real
+  accelerator with compile-excluded timing and a numerical-parity check
+  against the untuned kernel (a faster wrong kernel is a rejected
+  candidate), persisting winners with ``--update-table``.
+
+docs/performance.md ("Autotuned kernels") has the workflow; the CI
+table gate is ``python -m rocket_tpu.tune --check-table`` in
+scripts/check.sh.
+"""
+
+from rocket_tpu.tune.space import TUNE_SPACES, TuneSpace, canonical_dtype
+from rocket_tpu.tune.table import (
+    CONFIGS_DIR,
+    get_config,
+    load_table,
+    load_tables,
+    lookup_log,
+    lookup_log_summary,
+    priced_device_kind,
+    reset_lookup_log,
+    reset_table_cache,
+    tables_summary,
+    tuning_disabled,
+    validate_tables,
+    write_table,
+)
+
+__all__ = [
+    "TUNE_SPACES",
+    "TuneSpace",
+    "canonical_dtype",
+    "CONFIGS_DIR",
+    "get_config",
+    "load_table",
+    "load_tables",
+    "lookup_log",
+    "lookup_log_summary",
+    "priced_device_kind",
+    "reset_lookup_log",
+    "reset_table_cache",
+    "tables_summary",
+    "tuning_disabled",
+    "validate_tables",
+    "write_table",
+]

@@ -1,0 +1,149 @@
+"""CLI: ``python -m rocket_tpu.tune`` — sweep, validate, update.
+
+Process contract (matches ``python -m rocket_tpu.analysis``): exit 0 =
+clean, 1 = findings/failure, 2 = usage error.
+
+* default (no flags): sweep every builtin case on the local accelerator
+  and print the per-case results — nothing is written;
+* ``--update-table``: additionally persist winning configs into the
+  checked-in tables (``rocket_tpu/tune/configs/`` or ``--table-dir``).
+  Refused on CPU — interpret-mode timings are meaningless;
+* ``--check-table``: the CI table-staleness gate — schema validation,
+  legality re-verification of every entry against its TuneSpace, and
+  unknown-device-kind rejection. Runs anywhere (no accelerator);
+* ``--list``: the case and kernel catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.tune",
+        description="offline pallas launch-config autotuner "
+                    "(sweep + parity check + checked-in config tables)",
+    )
+    parser.add_argument("--list", action="store_true",
+                        help="print the kernel/case catalog and exit")
+    parser.add_argument("--check-table", action="store_true",
+                        help="validate the checked-in tables (schema, "
+                             "legality vs TuneSpace, known device kinds) "
+                             "and exit — the CI gate")
+    parser.add_argument("--kernel", action="append",
+                        help="sweep only these kernels")
+    parser.add_argument("--case", action="append",
+                        help="sweep only these named cases")
+    parser.add_argument("--update-table", action="store_true",
+                        help="persist winning configs into the table dir")
+    parser.add_argument("--table-dir", default=None,
+                        help="table directory (default: the checked-in "
+                             "rocket_tpu/tune/configs)")
+    parser.add_argument("--min-speedup", type=float, default=1.02,
+                        help="minimum tuned/default speedup before a "
+                             "winner is recorded (default 1.02)")
+    parser.add_argument("--iters", type=int, default=20,
+                        help="timed iterations per candidate")
+    parser.add_argument("--allow-cpu", action="store_true",
+                        help="run the tiny interpret-mode smoke subset on "
+                             "CPU (loop exercise only; no table writes)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON summary line on stdout")
+    args = parser.parse_args(argv)
+
+    from rocket_tpu.tune.table import validate_tables
+
+    if args.check_table:
+        problems = validate_tables(args.table_dir)
+        for problem in problems:
+            print(f"tune-table: {problem}", file=sys.stderr)
+        if args.json:
+            print(json.dumps({"problems": problems}))
+        elif not problems:
+            print("tune tables OK")
+        return 1 if problems else 0
+
+    from rocket_tpu.tune.tuner import load_cases, run_cases, update_tables
+
+    if args.list:
+        from rocket_tpu.tune.space import TUNE_SPACES
+
+        for name, space in sorted(TUNE_SPACES.items()):
+            axes = ", ".join(f"{k}={list(v)}" for k, v in
+                             sorted(space.axes.items()))
+            print(f"{name:18s} {axes}")
+        print()
+        for name, case in sorted(load_cases().items()):
+            tag = "  [smoke]" if case.smoke else ""
+            print(f"{name:22s} kernel={case.kernel} "
+                  f"shape={dict(case.shape)} {case.dtype}{tag}")
+        return 0
+
+    import jax
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and not args.allow_cpu:
+        print(
+            "tune: the local backend is CPU — pallas kernels would run "
+            "interpreted and every timing would be meaningless. Run on "
+            "an accelerator, or pass --allow-cpu for the tiny smoke "
+            "subset (no table writes).",
+            file=sys.stderr,
+        )
+        return 1
+    if on_cpu and args.update_table:
+        print("tune: --update-table refused on CPU (no real timings)",
+              file=sys.stderr)
+        return 2
+
+    reports = run_cases(
+        names=args.case, kernels=args.kernel,
+        iters=max(1, args.iters) if not on_cpu else 1,
+        min_speedup=args.min_speedup,
+        smoke_only=on_cpu,
+        log=lambda s: print(f"tune: {s}", file=sys.stderr),
+    )
+    summary = {
+        "device_kind": jax.devices()[0].device_kind,
+        "cases": {
+            r.case.name: {
+                "kernel": r.case.kernel,
+                "default_us": r.default_us,
+                "winner": None if r.winner is None else {
+                    "config": r.winner.config,
+                    "tuned_us": r.winner.mean_us,
+                    "speedup": r.speedup,
+                },
+                "rejected_parity": [
+                    res.config for res in r.results
+                    if not res.parity_ok and res.error is None
+                ],
+            }
+            for r in reports
+        },
+    }
+    if args.update_table:
+        summary["written"] = update_tables(reports, args.table_dir)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for name, rec in summary["cases"].items():
+            win = rec["winner"]
+            line = (f"{name}: default {rec['default_us']:.1f} us"
+                    if rec["default_us"] else f"{name}: no timing")
+            if win:
+                line += (f" -> tuned {win['tuned_us']:.1f} us "
+                         f"({win['speedup']:.3f}x) {win['config']}")
+            else:
+                line += " (no win; default kept)"
+            print(line)
+        for path in summary.get("written", []):
+            print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""Declarative tune spaces — the LEGAL launch-config set per pallas kernel.
+
+Each tunable kernel declares a :class:`TuneSpace`: the config axes the
+offline tuner (``python -m rocket_tpu.tune``) may sweep, the default
+config (today's hand-picked values — the runtime fallback when no table
+entry matches), and a legality predicate that rejects configs the
+hardware cannot run correctly or efficiently BEFORE anything is timed:
+
+* the flash kernels' causal path masks only diagonal blocks, which is
+  correct ONLY when ``block_q == block_k`` (`ops/flash_attention.py`
+  raises loudly on violation — an illegal tuner candidate fails fast
+  instead of returning wrong attention);
+* every block must respect the (sublane, 128) tile: the last dim a
+  multiple of 128 or the whole array dim, the sublane dim a multiple of
+  the dtype minimum (8 f32 / 16 bf16 / 32 int8);
+* the double-buffered VMEM estimate of one grid step's blocks must fit
+  the device's conservative scratch budget
+  (:class:`rocket_tpu.utils.perf.DeviceSpec.vmem_bytes` — the same
+  budget RKT504 gates statically).
+
+The registry (:data:`TUNE_SPACES`) is the single source of truth shared
+by the runtime lookup (``table.get_config`` buckets shapes with
+``TuneSpace.bucket``), the offline tuner (candidate enumeration) and the
+CI table gate (``table.validate_tables`` re-verifies every checked-in
+entry's legality against its space, so a stale table cannot ship a
+config a space change made illegal).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional, Tuple
+
+from rocket_tpu.utils.perf import DeviceSpec
+
+__all__ = ["TuneSpace", "TUNE_SPACES", "sublane_min", "canonical_dtype"]
+
+#: Minimum sublane multiple by dtype itemsize — same table as the RKT504
+#: pallas-block check (`analysis/rules/sched_rules.py`).
+_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+_DTYPE_ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
+
+def sublane_min(dtype: str) -> int:
+    return _SUBLANE.get(_DTYPE_ITEMSIZE.get(dtype, 4), 8)
+
+
+def canonical_dtype(dtype) -> str:
+    """'bfloat16' / 'float32' style name for a jnp dtype, dtype object or
+    string — the table's dtype key."""
+    name = getattr(dtype, "name", None)
+    if name is None:
+        import numpy as np
+
+        try:
+            name = np.dtype(dtype).name
+        except TypeError:
+            name = str(dtype)
+    return name
+
+
+@dataclass(frozen=True)
+class TuneSpace:
+    """The legal launch-config set for one tunable kernel.
+
+    ``axes`` maps config-key -> candidate values (the full cross product
+    is the raw search space; ``legal`` prunes it). ``default`` computes
+    today's hand-picked config for a shape — the runtime fallback, and
+    the baseline every candidate is timed and parity-checked against.
+    ``legal`` returns a list of human-readable violations (empty =
+    legal). ``shape_keys`` documents which shape-dict keys the bucket is
+    keyed on (validation rejects entries missing them).
+    """
+
+    kernel: str
+    axes: Mapping[str, Tuple]
+    shape_keys: Tuple[str, ...]
+    default: Callable[[Mapping], dict]
+    legal: Callable[[dict, Mapping, Optional[DeviceSpec], str], list] = \
+        field(default=lambda config, shape, spec, dtype: [])
+    doc: str = ""
+
+    def bucket(self, shape: Mapping) -> str:
+        """Deterministic shape-bucket string for the table key. Exact
+        shapes, not ranges: the tuner measures the exact bench shapes and
+        anything else falls back to the default config — the conservative
+        choice that keeps untuned shapes behavior-identical."""
+        parts = []
+        for key in self.shape_keys:
+            value = shape[key]
+            if isinstance(value, bool):
+                value = "t" if value else "f"
+            parts.append(f"{key}{value}")
+        return "_".join(parts)
+
+    def candidates(self, shape: Mapping, spec: Optional[DeviceSpec],
+                   dtype: str) -> list:
+        """Every LEGAL config in the axes cross product (default included
+        when legal), deterministic order."""
+        keys = sorted(self.axes)
+        out = []
+        for values in itertools.product(*(self.axes[k] for k in keys)):
+            config = dict(zip(keys, values))
+            if not self.legal(config, shape, spec, dtype):
+                out.append(config)
+        return out
+
+    def violations(self, config: Mapping, shape: Mapping,
+                   spec: Optional[DeviceSpec], dtype: str) -> list:
+        """Axis-membership + kernel legality violations for ``config``."""
+        problems = []
+        for key, value in config.items():
+            if key not in self.axes:
+                problems.append(f"unknown config axis {key!r}")
+            elif value not in self.axes[key]:
+                problems.append(
+                    f"{key}={value!r} not in candidates {self.axes[key]}"
+                )
+        for key in self.axes:
+            if key not in config:
+                # A partial config would KeyError in the kernel's
+                # resolution path — every axis must be pinned.
+                problems.append(f"config missing axis {key!r}")
+        for key in self.shape_keys:
+            if key not in shape:
+                problems.append(f"shape missing key {key!r}")
+        if problems:
+            return problems
+        return list(self.legal(dict(config), shape, spec, dtype))
+
+
+# -- per-kernel legality ------------------------------------------------------
+
+
+def _block_legal(block: int, t: int, dtype: str, what: str) -> list:
+    problems = []
+    if t % block:
+        problems.append(f"{what}={block} does not divide T={t}")
+    if block % sublane_min(dtype):
+        problems.append(
+            f"{what}={block} % {sublane_min(dtype)} sublane tile ({dtype})"
+        )
+    return problems
+
+
+def _flash_vmem_bytes(config, shape, dtype: str) -> int:
+    """Double-buffered VMEM estimate for one grid step of the native-
+    layout flash kernels (`ops/flash_native.py`): q/out blocks are
+    (block_q, h*d) wide, k/v blocks (block_k, h_kv*d), plus the f32
+    accumulator/stat scratch. Mirrors the 2x-per-block estimate RKT504
+    applies to the traced jaxpr (`sched_audit._pallas_fact`)."""
+    itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+    bq, bk = config["block_q"], config["block_k"]
+    qw = shape["h"] * shape["d"]
+    kw = shape["h_kv"] * shape["d"]
+    blocks = 2 * (bq * qw + 2 * bk * kw + bq * qw) * itemsize  # q,k,v,out x2
+    scratch = (qw * bq + 2 * shape["h"] * bq) * 4              # acc,m,l f32
+    return blocks + scratch
+
+
+def _flash_legal(config, shape, spec, dtype) -> list:
+    problems = []
+    t = shape["t"]
+    problems += _block_legal(config["block_q"], t, dtype, "block_q")
+    problems += _block_legal(config["block_k"], t, dtype, "block_k")
+    if shape.get("causal", True) and config["block_q"] != config["block_k"]:
+        # Diagonal-block masking is only correct on aligned square blocks
+        # — the kernel entry raises on this; reject before timing.
+        problems.append(
+            f"causal requires block_q == block_k "
+            f"(got {config['block_q']} != {config['block_k']})"
+        )
+    if spec is not None:
+        need = _flash_vmem_bytes(config, shape, dtype)
+        if need > spec.vmem_bytes:
+            problems.append(
+                f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+def _flash_default(shape) -> dict:
+    from rocket_tpu.ops.flash_attention import pick_block
+
+    block = pick_block(shape["t"], min(512, shape["t"])) or 512
+    return {"block_q": block, "block_k": block}
+
+
+def _decode_legal(config, shape, spec, dtype) -> list:
+    rows = config["rows"]
+    problems = []
+    if rows % 8:
+        problems.append(f"rows={rows} % 8 (Mosaic sublane minimum)")
+    if shape["t"] % rows:
+        problems.append(f"rows={rows} does not divide T_max={shape['t']}")
+    if spec is not None:
+        # The kernel holds the whole (Hkv, T, D) K and V cache blocks per
+        # grid cell; rows only sizes the aliased write-back tile.
+        itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+        cache = 2 * 2 * shape["hkv"] * shape["t"] * shape["d"] * itemsize
+        if cache > spec.vmem_bytes:
+            problems.append(
+                f"cache blocks {cache >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+def _gmm_legal(config, shape, spec, dtype) -> list:
+    problems = []
+    itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+    tm = min(config["tile_m"], shape["m"])
+    tk = min(config["tile_k"], shape["k"])
+    tn = min(config["tile_n"], shape["n"])
+    for name, tile in (("tile_k", tk), ("tile_n", tn)):
+        if tile % 128:
+            problems.append(f"{name}={tile} % 128 lane tile")
+    if tm % sublane_min(dtype):
+        problems.append(f"tile_m={tm} % {sublane_min(dtype)} sublane tile")
+    if spec is not None:
+        # lhs/rhs/out tiles double-buffered + the f32 accumulator scratch
+        # the megablox kernel allocates.
+        need = 2 * (tm * tk + tk * tn + tm * tn) * itemsize + tm * tn * 4
+        if need > spec.vmem_bytes:
+            problems.append(
+                f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+#: kernel name -> TuneSpace. The names are the table file names
+#: (``rocket_tpu/tune/configs/<kernel>.json``) and the runtime lookup
+#: keys (`table.get_config(kernel, ...)`).
+TUNE_SPACES: dict[str, TuneSpace] = {
+    space.kernel: space
+    for space in (
+        TuneSpace(
+            kernel="flash_fwd",
+            axes={"block_q": (128, 256, 512, 1024),
+                  "block_k": (128, 256, 512, 1024)},
+            shape_keys=("t", "d", "h", "h_kv", "causal"),
+            default=_flash_default,
+            legal=_flash_legal,
+            doc="flash attention forward (ops/flash_native.py _fwd and "
+                "ops/flash_attention.py _fwd): query/kv block sizes; "
+                "causal pins the diagonal to square blocks",
+        ),
+        TuneSpace(
+            kernel="flash_bwd",
+            axes={"block_q": (128, 256, 512, 1024),
+                  "block_k": (128, 256, 512, 1024)},
+            shape_keys=("t", "d", "h", "h_kv", "causal"),
+            default=_flash_default,
+            legal=_flash_legal,
+            doc="flash attention fused backward (dk/dv sweep + dq "
+                "partials): block sizes independent of the forward's",
+        ),
+        TuneSpace(
+            kernel="decode_attention",
+            axes={"rows": (8, 16, 32)},
+            shape_keys=("t", "d", "hkv"),
+            default=lambda shape: {"rows": 8},
+            legal=_decode_legal,
+            doc="fused decode attention (ops/decode_attention.py): the "
+                "aliased cache write-back tile height",
+        ),
+        TuneSpace(
+            kernel="paged_decode",
+            axes={"variant": ("gather",)},
+            shape_keys=("bl", "d", "hkv"),
+            default=lambda shape: {"variant": "gather"},
+            doc="paged-pool attention (ops/paged_attention.py): XLA "
+                "gather path today; the axis gains candidates when the "
+                "VMEM-streaming pallas kernel lands (ROADMAP serve note)",
+        ),
+        TuneSpace(
+            kernel="moe_gmm",
+            axes={"tile_m": (128, 256, 512, 1024),
+                  "tile_k": (128, 256, 512, 1024),
+                  "tile_n": (128, 256, 512, 1024)},
+            shape_keys=("m", "k", "n"),
+            default=lambda shape: {"tile_m": 512, "tile_k": 512,
+                                   "tile_n": 512},
+            legal=_gmm_legal,
+            doc="megablox gmm tiling for the dropless-MoE grouped "
+                "matmuls (nn/moe.py): (m, k, n) tile triple, clamped to "
+                "the operand dims at call",
+        ),
+        TuneSpace(
+            kernel="fused_bn",
+            axes={"moments": ("stacked", "separate")},
+            shape_keys=("c",),
+            default=lambda shape: {"moments": "stacked"},
+            doc="train-mode batchnorm statistics (nn/layers.py "
+                "_bn_train_impl): one stacked (C, 2) moment reduction "
+                "(default — one activation read, one collective under "
+                "data sharding) vs two separate mean/E[x^2] reductions",
+        ),
+    )
+}

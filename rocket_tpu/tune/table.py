@@ -1,0 +1,402 @@
+"""Checked-in tuned-config tables and the runtime lookup.
+
+One JSON file per kernel (``rocket_tpu/tune/configs/<kernel>.json``),
+modeled on the analysis budget machinery: the offline tuner writes them
+with ``python -m rocket_tpu.tune --update-table`` and CI re-validates
+every entry against its :class:`~rocket_tpu.tune.space.TuneSpace` on
+every run (``--check-table``), so a stale or hand-edited table cannot
+silently ship an illegal config.
+
+The runtime lookup (:func:`get_config`) is what the kernels call at
+trace time: keyed ``(device kind, shape bucket, dtype)`` with the same
+longest-prefix device-kind matching as the peak-FLOPs tables
+(``utils/perf._longest_prefix`` — "TPU v5 lite" beats "TPU v5", future
+suffixed kinds fall back to their family entry) and EXACT matching on
+shape bucket and dtype. No match returns ``None`` and the caller uses
+today's hand-picked default — CPU tests and unknown devices are
+behavior-identical to an untuned checkout by construction.
+
+Every lookup is recorded in a bounded provenance log so ``bench.py``
+can stamp which kernels actually ran tuned configs into each
+BENCH_DETAIL config record (table hit vs default fallback, entry key).
+
+``ROCKET_TPU_TUNE=0`` disables all lookups (every kernel falls back to
+its default); :func:`priced_device_kind` overrides the device kind the
+lookup resolves against — the static auditors use it to trace the
+blocks that would actually run on the audited target instead of the
+audit host's.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from typing import Mapping, Optional
+
+import jax
+
+from rocket_tpu.tune.space import TUNE_SPACES, canonical_dtype
+from rocket_tpu.utils.perf import DEVICE_SPECS, _longest_prefix, device_spec
+
+__all__ = [
+    "CONFIGS_DIR",
+    "get_config",
+    "load_table",
+    "load_tables",
+    "write_table",
+    "validate_tables",
+    "tables_summary",
+    "priced_device_kind",
+    "tuning_disabled",
+    "reset_lookup_log",
+    "lookup_log",
+    "lookup_log_summary",
+    "reset_table_cache",
+]
+
+#: Canonical checked-in table directory (inside the package so an
+#: installed wheel carries it; pyproject declares the package data).
+CONFIGS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "configs")
+
+TABLE_VERSION = 1
+
+_ENTRY_REQUIRED = ("device_kind", "dtype", "shape", "shape_bucket", "config")
+
+_lock = threading.Lock()
+_table_cache: dict[str, Optional[dict]] = {}
+_lookup_log: list[dict] = []
+_LOOKUP_LOG_MAX = 256
+
+_override = threading.local()
+
+
+def _configs_dir() -> str:
+    """The active table directory: ``ROCKET_TPU_TUNE_DIR`` (tests, local
+    experiments) or the checked-in package directory."""
+    return os.environ.get("ROCKET_TPU_TUNE_DIR") or CONFIGS_DIR
+
+
+def _enabled() -> bool:
+    return os.environ.get("ROCKET_TPU_TUNE", "1") not in ("0", "off")
+
+
+@contextlib.contextmanager
+def tuning_disabled():
+    """Force every :func:`get_config` lookup inside the block to miss
+    (kernels run their hand-picked defaults). The offline tuner sweeps
+    under this so the baseline and every candidate run EXACTLY the
+    blocks it pins — an existing table entry must not contaminate its
+    own re-measurement."""
+    prev = os.environ.get("ROCKET_TPU_TUNE")
+    os.environ["ROCKET_TPU_TUNE"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("ROCKET_TPU_TUNE", None)
+        else:
+            os.environ["ROCKET_TPU_TUNE"] = prev
+
+
+@contextlib.contextmanager
+def priced_device_kind(kind: Optional[str]):
+    """Force every :func:`get_config` lookup inside the block to resolve
+    against ``kind`` instead of the local device's kind. The static
+    auditors (sched_audit RKT504) trace kernels under this so the block
+    shapes they check are the ones the audited target would actually
+    run; ``None`` is a no-op."""
+    prev = getattr(_override, "kind", None)
+    _override.kind = kind
+    try:
+        yield
+    finally:
+        _override.kind = prev
+
+
+def table_path(kernel: str, configs_dir: Optional[str] = None) -> str:
+    return os.path.join(configs_dir or _configs_dir(), f"{kernel}.json")
+
+
+def load_table(kernel: str, configs_dir: Optional[str] = None,
+               use_cache: bool = True) -> Optional[dict]:
+    """The parsed table for ``kernel`` or None when absent/corrupt. The
+    runtime lookup must never die on a bad file — validation is CI's
+    job (:func:`validate_tables`)."""
+    path = table_path(kernel, configs_dir)
+    if use_cache:
+        with _lock:
+            if path in _table_cache:
+                return _table_cache[path]
+    try:
+        with open(path) as fh:
+            table = json.load(fh)
+        if not isinstance(table, dict) or \
+                not isinstance(table.get("entries"), list):
+            table = None
+    except (OSError, ValueError):
+        table = None
+    if use_cache:
+        with _lock:
+            _table_cache[path] = table
+    return table
+
+
+def load_tables(configs_dir: Optional[str] = None) -> dict:
+    """kernel -> table for every registered TuneSpace (missing files map
+    to None)."""
+    return {kernel: load_table(kernel, configs_dir)
+            for kernel in TUNE_SPACES}
+
+
+def reset_table_cache() -> None:
+    """Drop the per-process table cache (tests repoint
+    ``ROCKET_TPU_TUNE_DIR`` mid-process)."""
+    with _lock:
+        _table_cache.clear()
+
+
+def write_table(kernel: str, entries: list,
+                configs_dir: Optional[str] = None) -> str:
+    """Atomically write ``entries`` as ``kernel``'s table; returns the
+    path (the ``--update-table`` workhorse, same shape as
+    ``analysis.budgets.write_budget``)."""
+    directory = configs_dir or _configs_dir()
+    os.makedirs(directory, exist_ok=True)
+    path = table_path(kernel, directory)
+    table = {
+        "version": TABLE_VERSION,
+        "kernel": kernel,
+        "entries": sorted(
+            (dict(e) for e in entries),
+            key=lambda e: (e.get("device_kind", ""),
+                           e.get("shape_bucket", ""), e.get("dtype", "")),
+        ),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(table, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    reset_table_cache()
+    return path
+
+
+# -- runtime lookup -----------------------------------------------------------
+
+
+def _resolve_kind(device_kind: Optional[str]) -> str:
+    kind = getattr(_override, "kind", None)
+    if kind is not None:
+        return kind
+    if device_kind is not None:
+        return device_kind
+    return jax.devices()[0].device_kind
+
+
+def _log(record: dict) -> None:
+    with _lock:
+        if len(_lookup_log) < _LOOKUP_LOG_MAX:
+            _lookup_log.append(record)
+
+
+def get_config(
+    kernel: str,
+    *,
+    shape: Mapping,
+    dtype,
+    device_kind: Optional[str] = None,
+) -> Optional[dict]:
+    """The tuned config for ``kernel`` at ``shape``/``dtype`` on the
+    (resolved) device kind, or ``None`` when no entry matches — the
+    caller then uses its hand-picked default, so an empty/absent table
+    is behavior-identical to an untuned checkout.
+
+    ``shape`` is the kernel's shape-args dict (the keys its TuneSpace
+    declares — e.g. ``{"t":, "d":, "h":, "h_kv":, "causal":}`` for the
+    flash kernels); the bucket string is derived from it. Device-kind
+    matching is longest-prefix over the table's entries; shape bucket
+    and dtype match exactly (the tuner measured THOSE shapes — anything
+    else stays on the default).
+    """
+    space = TUNE_SPACES.get(kernel)
+    if space is None:
+        raise KeyError(f"tune.get_config: unknown kernel {kernel!r} — "
+                       f"known: {sorted(TUNE_SPACES)}")
+    if not _enabled():
+        return None
+    bucket = space.bucket(shape)
+    dtype_name = canonical_dtype(dtype)
+    kind = _resolve_kind(device_kind)
+    record = {
+        "kernel": kernel, "shape_bucket": bucket, "dtype": dtype_name,
+        "device_kind": kind, "source": "default",
+    }
+    table = load_table(kernel)
+    config = None
+    if table is not None:
+        by_kind: dict[str, dict] = {}
+        for entry in table["entries"]:
+            if entry.get("shape_bucket") != bucket:
+                continue
+            if entry.get("dtype") != dtype_name:
+                continue
+            ekind = entry.get("device_kind")
+            if isinstance(ekind, str) and isinstance(entry.get("config"),
+                                                     dict):
+                by_kind[ekind] = entry["config"]
+        if by_kind:
+            config = _longest_prefix(by_kind, kind)
+    if config is not None:
+        record["source"] = "table"
+        record["config"] = dict(config)
+        _log(record)
+        return dict(config)
+    _log(record)
+    return None
+
+
+# -- lookup provenance (bench.py stamps it per config) ------------------------
+
+
+def reset_lookup_log() -> None:
+    with _lock:
+        _lookup_log.clear()
+
+
+def lookup_log() -> list:
+    with _lock:
+        return [dict(r) for r in _lookup_log]
+
+
+def lookup_log_summary() -> list:
+    """Deduplicated lookup records since the last reset — the kernel-
+    config provenance bench.py records per measured config (table hit vs
+    default fallback, with the resolved config on hits)."""
+    seen = set()
+    out = []
+    for record in lookup_log():
+        key = (record["kernel"], record["shape_bucket"], record["dtype"],
+               record["device_kind"], record["source"])
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(record)
+    return out
+
+
+# -- validation (the CI table-staleness gate) ---------------------------------
+
+
+def _validate_entry(kernel: str, index: int, entry, known_kinds) -> list:
+    space = TUNE_SPACES[kernel]
+    where = f"{kernel}.json entries[{index}]"
+    if not isinstance(entry, Mapping):
+        return [f"{where}: not an object"]
+    problems = []
+    for key in _ENTRY_REQUIRED:
+        if key not in entry:
+            problems.append(f"{where}: missing required key {key!r}")
+    if problems:
+        return problems
+    kind = entry["device_kind"]
+    if _longest_prefix(known_kinds, kind) is None:
+        problems.append(
+            f"{where}: unknown device kind {kind!r} — add it to "
+            "rocket_tpu.utils.perf.DEVICE_SPECS or drop the entry"
+        )
+        spec = None
+    else:
+        spec = device_spec(kind)
+    shape = entry["shape"]
+    if not isinstance(shape, Mapping):
+        return problems + [f"{where}: shape is not an object"]
+    missing = [k for k in space.shape_keys if k not in shape]
+    if missing:
+        return problems + [f"{where}: shape missing keys {missing}"]
+    if entry["shape_bucket"] != space.bucket(shape):
+        problems.append(
+            f"{where}: shape_bucket {entry['shape_bucket']!r} does not "
+            f"match shape (expected {space.bucket(shape)!r})"
+        )
+    config = entry["config"]
+    if not isinstance(config, Mapping):
+        return problems + [f"{where}: config is not an object"]
+    for violation in space.violations(config, shape, spec, entry["dtype"]):
+        problems.append(f"{where}: illegal config — {violation}")
+    return problems
+
+
+def validate_tables(configs_dir: Optional[str] = None) -> list:
+    """Every problem in the table directory, as human-readable strings
+    (empty = gate passes). Checks: parseable files for every registered
+    kernel, schema fields, no entries for unknown device kinds, bucket/
+    shape consistency, and a fresh legality re-verification of every
+    config against its TuneSpace."""
+    directory = configs_dir or _configs_dir()
+    problems = []
+    known_kinds = dict(DEVICE_SPECS)
+    for kernel in sorted(TUNE_SPACES):
+        path = table_path(kernel, directory)
+        if not os.path.exists(path):
+            problems.append(
+                f"{kernel}.json: missing — every tunable kernel ships a "
+                "table (empty entries when nothing is tuned); run "
+                "`python -m rocket_tpu.tune --update-table`"
+            )
+            continue
+        table = load_table(kernel, directory, use_cache=False)
+        if table is None:
+            problems.append(f"{kernel}.json: unreadable or malformed")
+            continue
+        if table.get("version") != TABLE_VERSION:
+            problems.append(
+                f"{kernel}.json: version {table.get('version')!r} != "
+                f"{TABLE_VERSION}"
+            )
+        if table.get("kernel") != kernel:
+            problems.append(
+                f"{kernel}.json: kernel field {table.get('kernel')!r} "
+                f"does not match the file name"
+            )
+        for i, entry in enumerate(table["entries"]):
+            problems.extend(_validate_entry(kernel, i, entry, known_kinds))
+    for name in sorted(os.listdir(directory)) \
+            if os.path.isdir(directory) else []:
+        stem, ext = os.path.splitext(name)
+        if ext == ".json" and stem not in TUNE_SPACES:
+            problems.append(
+                f"{name}: no TuneSpace named {stem!r} — stale table for a "
+                "removed kernel?"
+            )
+    return problems
+
+
+def tables_summary(configs_dir: Optional[str] = None) -> Optional[dict]:
+    """Per-kernel entry summary for BENCH_DETAIL's ``tune`` record:
+    entry counts plus each entry's (device kind, bucket, dtype, speedup)
+    so tuned-vs-default speedup is tracked per kernel per device kind.
+    None when the directory is entirely absent."""
+    directory = configs_dir or _configs_dir()
+    if not os.path.isdir(directory):
+        return None
+    kernels = {}
+    for kernel in sorted(TUNE_SPACES):
+        table = load_table(kernel, directory, use_cache=False)
+        entries = []
+        for entry in (table or {}).get("entries", []):
+            if not isinstance(entry, Mapping):
+                continue
+            entries.append({
+                key: entry.get(key)
+                for key in ("device_kind", "shape_bucket", "dtype",
+                            "config", "speedup", "tuned_us", "default_us")
+                if entry.get(key) is not None
+            })
+        kernels[kernel] = {"n_entries": len(entries), "entries": entries}
+    return {"kernels": kernels, "source": os.path.relpath(
+        directory, os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    )}

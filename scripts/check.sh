@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
-# SPMD shard audit (self-gate + budget diff) + precision audit
-# (dtype-flow self-gate + numerics budgets) + schedule audit + serving
-# audit (retrace-surface/latency/HBM self-gate + serving budgets) +
-# obs telemetry smoke + resilience smoke (supervised restart / drain) +
+# tune table gate (checked-in kernel-config legality) + SPMD shard
+# audit (self-gate + budget diff) + precision audit (dtype-flow
+# self-gate + numerics budgets) + schedule audit + serving audit
+# (retrace-surface/latency/HBM self-gate + serving budgets) + obs
+# telemetry smoke + resilience smoke (supervised restart / drain) +
 # the tier-1 test suite (command from ROADMAP.md). Exits non-zero on
 # the first failing stage.
 set -euo pipefail
@@ -18,6 +19,13 @@ fi
 
 echo "== rocketlint (python -m rocket_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis rocket_tpu/
+
+echo "== tune table gate (schema + legality of checked-in kernel configs) =="
+# Validates every entry in rocket_tpu/tune/configs/*.json: schema
+# fields, known device kinds, bucket/shape consistency, and a fresh
+# legality re-verification against each kernel's TuneSpace — a stale or
+# hand-edited table cannot ship an illegal launch config.
+JAX_PLATFORMS=cpu python -m rocket_tpu.tune --check-table
 
 echo "== shard audit (SPMD self-gate + budgets) =="
 # Fake 1x8 / 2x4 CPU meshes; fails on sharding-rule findings or a >10%

@@ -11,6 +11,7 @@ re-break the artifact.
 """
 
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -267,6 +268,64 @@ def test_serve_calibration_ties_prediction_to_measured_record():
     # table, the prediction prices the reference kind instead.
     assert calib["priced_for"]
     assert isinstance(calib["device_matched"], bool)
+
+
+def test_write_detail_carries_tune_record(tmp_path):
+    """BENCH_DETAIL.json carries the tuned-kernel config record
+    (rocket_tpu.tune tables): one row per tunable kernel with its entry
+    list — each entry keyed (device kind, shape bucket, dtype) and
+    carrying the tuner-measured speedup — plus this run's device kind,
+    so tuned-vs-default speedup is tracked per kernel per device kind."""
+    from rocket_tpu.tune.space import TUNE_SPACES
+
+    path = tmp_path / "BENCH_DETAIL.json"
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    record = json.loads(path.read_text())["tune"]
+    assert set(record["kernels"]) == set(TUNE_SPACES)
+    for kernel, row in record["kernels"].items():
+        assert isinstance(row["n_entries"], int) and row["n_entries"] >= 0
+        assert len(row["entries"]) == row["n_entries"]
+        for entry in row["entries"]:
+            assert entry["device_kind"] and entry["shape_bucket"]
+            assert entry["speedup"] > 1.0  # only wins are persisted
+    assert record["device_kind"]
+    assert record["source"].endswith(os.path.join("tune", "configs"))
+
+
+def test_tune_summary_missing_tables_is_none(tmp_path):
+    """A checkout without the tune config tables must not break
+    emission."""
+    assert bench.tune_summary(str(tmp_path / "nowhere")) is None
+    path = tmp_path / "BENCH_DETAIL.json"
+    real = bench.TUNE_CONFIGS_DIR
+    bench.TUNE_CONFIGS_DIR = str(tmp_path / "nowhere")
+    try:
+        bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    finally:
+        bench.TUNE_CONFIGS_DIR = real
+    assert "tune" not in json.loads(path.read_text())
+
+
+def test_tune_summary_reports_table_entries(tmp_path):
+    """A table with a tuned entry surfaces its speedup row and device
+    kind in the summary (the shape the tuner's --update-table writes)."""
+    from rocket_tpu.tune.space import TUNE_SPACES
+    from rocket_tpu.tune.table import write_table
+
+    for kernel in TUNE_SPACES:
+        write_table(kernel, [], configs_dir=str(tmp_path))
+    write_table("flash_fwd", [{
+        "device_kind": "TPU v5 lite", "dtype": "bfloat16",
+        "shape": {"t": 1024, "d": 64, "h": 12, "h_kv": 12, "causal": True},
+        "shape_bucket": "t1024_d64_h12_h_kv12_causalt",
+        "config": {"block_q": 256, "block_k": 256},
+        "default_us": 100.0, "tuned_us": 90.0, "speedup": 1.111,
+    }], configs_dir=str(tmp_path))
+    summary = bench.tune_summary(str(tmp_path))
+    row = summary["kernels"]["flash_fwd"]
+    assert row["n_entries"] == 1
+    assert row["entries"][0]["speedup"] == 1.111
+    assert summary["table_device_kinds"] == ["TPU v5 lite"]
 
 
 def test_prec_audit_summary_missing_budgets_is_none(tmp_path):
