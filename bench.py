@@ -930,6 +930,8 @@ def serve_audit_summary(serve=None, budgets_dir=SERVE_BUDGETS_DIR):
                 for key in ("predicted_itl_us", "predicted_ttft_us",
                             "itl_floor_us", "overfetch_ratio",
                             "hbm_total_bytes", "host_bytes_per_wave",
+                            "host_bytes_per_dispatch",
+                            "byte_model", "waves_per_dispatch",
                             "device_kind")
             }
             worst_itl = max(worst_itl, record.get("predicted_itl_us") or 0)
@@ -1133,8 +1135,12 @@ def serve_summary(requests=64, warmup_requests=8):
         )
         model = TransformerLM(config)
         params = jax.jit(model.init)(jax.random.key(0))["params"]
+        # Byte-identical to the serve_audit `charlm` target (including
+        # the k-wave scan) so the calibration leg compares like with
+        # like: k=4 amortizes the dispatch tunnel 4x per device_get.
         serve_cfg = ServeConfig(
-            max_slots=8, block_len=16, prefill_chunk=32, max_model_len=256
+            max_slots=8, block_len=16, prefill_chunk=32, max_model_len=256,
+            decode_waves_per_dispatch=4,
         )
 
         def run(engine, n, seed):
@@ -1160,6 +1166,7 @@ def serve_summary(requests=64, warmup_requests=8):
                 for k, v in (block or {}).items() if k != "count"
             }
 
+        dispatch = report["dispatch"]
         return {
             "config": "charlm_256",
             "requests": requests,
@@ -1169,6 +1176,13 @@ def serve_summary(requests=64, warmup_requests=8):
             "itl_ms": _ms(report["inter_token_latency_s"]),
             "decode_traces": report["compiled"]["decode_traces"],
             "prefill_traces": report["compiled"]["prefill_traces"],
+            # Tunnel amortization (ISSUE 11): decoded tokens per device
+            # dispatch, host syncs actually paid, and the fraction of
+            # host loop time overlapped with the in-flight dispatch.
+            "waves_per_dispatch": dispatch["waves_per_dispatch"],
+            "tokens_per_dispatch": dispatch["tokens_per_dispatch"],
+            "device_get_count": dispatch["device_get_count"],
+            "host_overlap_fraction": dispatch["host_overlap_fraction"],
             "occupancy_mean": round(report["slots"]["occupancy_mean"], 2),
             "kv_pool_mib": round(
                 report["pool"]["kv_pool_bytes"] / 2**20, 1

@@ -73,6 +73,7 @@ __all__ = [
     "CompiledServeProgram",
     "compile_serve_programs",
     "decode_floor_bytes",
+    "fused_decode_bytes",
     "estimate_serve_hbm",
     "audit_serving",
     "ServeAuditReport",
@@ -130,12 +131,13 @@ class WaveObservation:
 
 #: The scheduler-supplied decode-wave inputs, in call order — the
 #: arguments after (params, k_pages, v_pages) and before the PRNG key.
-#: One definition shared by :class:`RecordingEngine.decode`'s recording
-#: and the mirror-vs-compiled-aval cross-check in :func:`audit_serving`,
-#: so a future arity change cannot silently vacuate the check.
+#: One definition shared by :class:`RecordingEngine.decode_dispatch`'s
+#: recording and the mirror-vs-compiled-aval cross-check in
+#: :func:`audit_serving`, so a future arity change cannot silently
+#: vacuate the check.
 SCHEDULER_WAVE_ARGS = (
     "block_table", "lengths", "last_tok", "run_mask", "limits",
-    "temp", "top_k", "top_p", "eos", "salts",
+    "temp", "top_k", "top_p", "eos", "seeds",
 )
 
 #: State labels :func:`enumerate_admission_lattice` must observe for the
@@ -160,20 +162,24 @@ class RecordingEngine:
     dispatching to a device.
 
     The scheduler's host logic (mirror mutation, admission, eviction,
-    harvest) runs for real; only the device half is simulated:
-    ``decode`` computes ``done`` exactly the way the compiled wave does
-    (``lengths + active >= limits``), and ``force_eos`` lets the lattice
-    driver finish a chosen slot early — the EOS-mid-wave state.
+    pipelined dispatch-then-harvest) runs for real; only the device half
+    is simulated: ``decode_dispatch`` replays the k-wave scan's carry
+    exactly the way the compiled program does (per-wave ``done`` from
+    ``lengths + active >= limits``, the run mask freezing mid-scan
+    finishes), and ``force_eos`` lets the lattice driver finish a chosen
+    slot early — the EOS-mid-wave state.
     """
 
     def __init__(self, spec, *, max_slots: int, max_blocks_per_seq: int,
-                 prefill_chunk: int, max_seq_len: int) -> None:
+                 prefill_chunk: int, max_seq_len: int,
+                 waves_per_dispatch: int = 1) -> None:
         from types import SimpleNamespace
 
         self.spec = spec
         self.max_slots = int(max_slots)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.prefill_chunk = int(prefill_chunk)
+        self.waves_per_dispatch = int(waves_per_dispatch)
         # The scheduler only reads model.config.max_seq_len.
         self.model = SimpleNamespace(
             config=SimpleNamespace(max_seq_len=int(max_seq_len))
@@ -181,6 +187,9 @@ class RecordingEngine:
         self.decode_traces = 1
         self.prefill_traces = 1
         self.decode_waves = 0
+        self.decode_dispatches = 0
+        self.device_gets = 0
+        self.harvest_wait_s = 0.0
         self.prefill_chunks = 0
         self.observations: list[WaveObservation] = []
         self.state = "init"
@@ -198,22 +207,42 @@ class RecordingEngine:
     def _signature(self, program: str, args: Sequence) -> Tuple:
         return wave_signature(args)
 
-    def decode(self, block_table, lengths, last_tok, run_mask, limits,
-               temp, top_k, top_p, eos, salts):
-        self.decode_waves += 1
+    def decode_dispatch(self, block_table, lengths, last_tok, run_mask,
+                        limits, temp, top_k, top_p, eos, seeds):
+        self.decode_dispatches += 1
+        self.decode_waves += self.waves_per_dispatch
         args = (block_table, lengths, last_tok, run_mask, limits,
-                temp, top_k, top_p, eos, salts)
+                temp, top_k, top_p, eos, seeds)
         assert len(args) == len(SCHEDULER_WAVE_ARGS)
         self._record("decode", args)
-        valid = run_mask.astype(np.int32)
-        nxt = np.where(run_mask, (last_tok + 1) % 7, last_tok).astype(np.int32)
-        done = (lengths + valid >= limits) & run_mask
-        for slot in list(self.force_eos):
-            self.force_eos[slot] -= 1
-            if self.force_eos[slot] <= 0 and run_mask[slot]:
-                done[slot] = True
-                del self.force_eos[slot]
-        return nxt, done
+        lengths = np.asarray(lengths).copy()
+        last = np.asarray(last_tok).copy()
+        run = np.asarray(run_mask).copy()
+        toks, done, emitted = [], [], []
+        for _wave in range(self.waves_per_dispatch):
+            valid = run.astype(np.int32)
+            nxt = np.where(run, (last + 1) % 7, last).astype(np.int32)
+            d = (lengths + valid >= limits) & run
+            for slot in list(self.force_eos):
+                self.force_eos[slot] -= 1
+                if self.force_eos[slot] <= 0 and run[slot]:
+                    d[slot] = True
+                    del self.force_eos[slot]
+            toks.append(nxt)
+            done.append(d)
+            emitted.append(run.copy())
+            lengths = lengths + valid
+            last = nxt
+            run = run & ~d
+        return np.stack(toks), np.stack(done), np.stack(emitted)
+
+    def harvest(self, handle):
+        self.device_gets += 1
+        return handle
+
+    def decode(self, *args):
+        """Dispatch-and-wait convenience, mirroring SlotEngine."""
+        return self.harvest(self.decode_dispatch(*args))
 
     def prefill(self, block_table_row, tokens, position, valid) -> None:
         self.prefill_chunks += 1
@@ -284,10 +313,18 @@ def enumerate_admission_lattice(
         engine.state = state
         sched.tick()
 
+    # Generation lengths are sized in BLOCKS, not ticks: every request
+    # outlives the whole drive unless finished deliberately (force_eos)
+    # — the pipelined scheduler harvests one dispatch behind and scans
+    # k waves per dispatch, so a tick-counted workload would drain
+    # early on a large ``waves_per_dispatch`` and leave full-occupancy/
+    # eviction states unreachable (a vacuous proof).
+    long_gen = 2 * block_len + 2
+
     # 1. empty -> first admission. The prompt spans several prefill
     # chunks and its tail chunk is PARTIAL (P-1 = 2.5 chunks).
     long_prompt = min(2 * chunk + max(chunk // 2, 1) + 1, max_ctx - 4)
-    submit(long_prompt, 4, temperature=0.7, top_k=3, eos_token_id=5)
+    submit(long_prompt, long_gen, temperature=0.7, top_k=3, eos_token_id=5)
     tick("first_admit")
     while not sched.idle and any(
         st is not None and not st.prefill_done for st in sched.slots
@@ -301,7 +338,7 @@ def enumerate_admission_lattice(
 
     # 2. fill every slot (mixed sampling knobs — runtime values only).
     for i in range(slots - 1):
-        submit(1 + i % 3, 6 + i, temperature=float(i % 2),
+        submit(1 + i % 3, long_gen + i, temperature=float(i % 2),
                top_p=0.9 if i % 2 else None,
                eos_token_id=None if i % 2 else 5)
     for _ in range(2 * slots):
@@ -327,16 +364,23 @@ def enumerate_admission_lattice(
     # 4. refill the freed slot from the queue — sized to CROSS a block
     # boundary mid-generation (plen 2 starts with one block; the +4
     # tokens past block_len force a table growth), which is what the
-    # eviction phase below starves.
+    # eviction phase below starves. Two ticks: the EOS finish above is
+    # harvested one tick behind its dispatch (pipelining), so the first
+    # refill tick discovers the freed slot and the second re-admits
+    # into it.
     submit(2, block_len + 4, temperature=0.3)
+    tick("refill")
     tick("refill")
 
     # 5. eviction: hold every free block (re-grabbing any that finishing
-    # requests return) so the refill request's table growth exhausts the
-    # pool and the youngest active request preempts.
+    # requests return) so the live slots' table growth exhausts the
+    # pool and the youngest active request preempts. Every request was
+    # sized to keep generating past several block boundaries, so growth
+    # demand keeps arriving no matter how the harvest lag interleaves
+    # block frees with the grow phase.
     hold: list[int] = []
     before = sched.preemptions
-    for _ in range(4 * block_len):
+    for _ in range(8 * block_len):
         if sched.preemptions > before:
             break
         got = sched.allocator.alloc(sched.allocator.num_free)
@@ -467,12 +511,19 @@ def compile_serve_programs(
     max_slots: int,
     max_blocks_per_seq: int,
     prefill_chunk: int,
+    waves_per_dispatch: int = 1,
     device_kind: str = DEFAULT_DEVICE_KIND,
     donate: bool = True,
     abs_inputs=None,
 ) -> tuple[list[CompiledServeProgram], list[Finding]]:
-    """AOT-compile the REAL decode-wave and prefill-chunk programs from
-    abstract inputs and price them with the roofline. ``donate=False``
+    """AOT-compile the REAL serving programs from abstract inputs and
+    price them with the roofline. Three programs when the target scans
+    k > 1 waves per dispatch: ``decode`` (the REAL k-wave scan — the
+    retrace/donation/host-transfer facts audit what actually runs),
+    ``decode_wave`` (a single-wave compile of the same body — the
+    per-wave attribution the roofline prices, free of while-loop
+    body-counting ambiguity), and ``prefill``. At k=1 ``decode`` IS the
+    single wave and ``decode_wave`` is omitted. ``donate=False``
     compiles without pool donation (the seeded-bad demo — RKT604's true
     positive). ``abs_inputs`` takes a precomputed
     :func:`~rocket_tpu.serve.engine.abstract_wave_inputs` pair so a
@@ -492,12 +543,21 @@ def compile_serve_programs(
             prefill_chunk=prefill_chunk,
         )
     decode_args, prefill_args = abs_inputs
+    k = int(waves_per_dispatch)
+    to_compile = [
+        ("decode", build_decode_wave(model, waves=k), decode_args,
+         DECODE_DONATE),
+        ("prefill", build_prefill_step(model), prefill_args,
+         PREFILL_DONATE),
+    ]
+    if k > 1:
+        to_compile.insert(1, (
+            "decode_wave", build_decode_wave(model, waves=1), decode_args,
+            DECODE_DONATE,
+        ))
     programs: list[CompiledServeProgram] = []
     findings: list[Finding] = []
-    for name, fn, args, donate_argnums in (
-        ("decode", build_decode_wave(model), decode_args, DECODE_DONATE),
-        ("prefill", build_prefill_step(model), prefill_args, PREFILL_DONATE),
-    ):
+    for name, fn, args, donate_argnums in to_compile:
         prog, prog_findings = _compile_program(
             name, fn, args, donate_argnums if donate else (), device_kind
         )
@@ -537,6 +597,31 @@ def decode_floor_bytes(
     )
     scatter = 2 * spec.num_layers * max_slots * row
     return int(params_bytes + kv_gather + scatter)
+
+
+def fused_decode_bytes(
+    spec,
+    params_bytes: int,
+    *,
+    max_slots: int,
+    max_blocks_per_seq: int,
+    vocab_size: int,
+) -> int:
+    """The fused-kernel byte model of ONE decode wave: the analytic
+    floor (params + active-pages-only gather + per-slot scatter — the
+    pallas paged-decode kernel streams exactly the mapped pages, no
+    transient ``(S, MB*BL, Hkv, D)`` context) plus the wave's real
+    activation traffic: the ``(S, V)`` logits written by the head and
+    re-read (several times — sort-based top-k/top-p filtering is always
+    compiled in, the knobs being runtime arrays) by the sampling core,
+    in f32. This is what the compiled wave moves on a TPU where the
+    kernel engages — the RKT602 re-pricing of ISSUE 11."""
+    floor = decode_floor_bytes(
+        spec, params_bytes, max_slots=max_slots,
+        max_blocks_per_seq=max_blocks_per_seq,
+    )
+    logits = 4 * max_slots * vocab_size * 4  # f32, head write + ~3 reads
+    return int(floor + logits)
 
 
 def estimate_serve_hbm(
@@ -631,14 +716,16 @@ def audit_serving(
             f"serve_audit: unknown device kind {device_kind!r} — add it "
             "to rocket_tpu.utils.perf.DEVICE_SPECS"
         )
-    spec, mb, _num_blocks = serve_config.resolve(model.config)
+    spec, mb, _num_blocks, waves = serve_config.resolve(model.config)
     report = ServeAuditReport(label=label)
     findings: list[Finding] = []
 
-    # 1/5. the two compiled programs + donation/alias facts. The
-    # abstract inputs are evaluated ONCE here: the compile harness
-    # consumes them, and their cast param avals (decode arg 0) are the
-    # params-bytes fact the roofline floor reads below.
+    # 1/5. the compiled programs + donation/alias facts — the REAL
+    # k-wave scan the engine dispatches, plus a single-wave compile for
+    # per-wave attribution when k > 1. The abstract inputs are evaluated
+    # ONCE here: the compile harness consumes them, and their cast param
+    # avals (decode arg 0) are the params-bytes fact the roofline floor
+    # reads below.
     from rocket_tpu.serve.engine import abstract_wave_inputs
 
     abs_inputs = abstract_wave_inputs(
@@ -649,6 +736,7 @@ def audit_serving(
         model, spec,
         max_slots=serve_config.max_slots, max_blocks_per_seq=mb,
         prefill_chunk=serve_config.prefill_chunk,
+        waves_per_dispatch=waves,
         device_kind=device_kind, donate=donate, abs_inputs=abs_inputs,
     )
     findings.extend(compile_findings)
@@ -661,6 +749,7 @@ def audit_serving(
         spec, max_slots=serve_config.max_slots, max_blocks_per_seq=mb,
         prefill_chunk=serve_config.prefill_chunk,
         max_seq_len=model.config.max_seq_len,
+        waves_per_dispatch=waves,
     )
     observations, lattice_findings, states_seen = \
         enumerate_admission_lattice(engine)
@@ -697,21 +786,54 @@ def audit_serving(
                     "retrace the engine's compiled program",
                 ))
 
-    # 3. latency roofline: ITL = one decode wave; TTFT = the chunked
-    # prefill schedule for the reference prompt + the first wave.
+    # 3. latency roofline. Per-wave attribution comes from the
+    # single-wave compile ("decode_wave" at k > 1, else "decode"
+    # itself); the REAL k-wave program keeps the donation/signature/
+    # host-transfer facts. Predicted ITL is per TOKEN — the k-wave scan
+    # amortizes the dispatch tunnel, it does not change per-wave device
+    # time — priced under the FUSED-KERNEL byte model (active-pages-only
+    # gather + logits/sampling traffic) wherever the pallas paged-decode
+    # kernel engages on the audited device kind, and under the compiled
+    # XLA program's unique-bytes model otherwise.
+    from rocket_tpu.ops.paged_attention import paged_decode_supported
+
     params_bytes = _tree_bytes(abs_inputs[0][0])
     floor = decode_floor_bytes(
         spec, params_bytes,
         max_slots=serve_config.max_slots, max_blocks_per_seq=mb,
     )
-    itl_us = decode.wave_time_us if decode else None
+    wave = by_name.get("decode_wave") or decode
+    kernel_engages = paged_decode_supported(
+        spec.block_len, spec.head_dim, np.dtype(spec.dtype).itemsize
+    )
+    fused = fused_decode_bytes(
+        spec, params_bytes,
+        max_slots=serve_config.max_slots, max_blocks_per_seq=mb,
+        vocab_size=int(model.config.vocab_size),
+    )
+    itl_us = None
+    priced_bytes = None
+    if wave is not None:
+        if kernel_engages:
+            wave_s = max(
+                wave.record["flops_per_step"] / device.flops_bf16,
+                fused / device.hbm_bw,
+            )
+            itl_us = round(wave_s * 1e6, 3)
+            priced_bytes = fused
+        else:
+            itl_us = wave.wave_time_us
+            priced_bytes = wave.wave_hbm_bytes
     prefill = by_name.get("prefill")
     chunk_us = prefill.wave_time_us if prefill else None
     ttft_us = None
     if itl_us is not None and chunk_us is not None:
+        # The first token is PRODUCED after one wave but only OBSERVED
+        # after the whole first k-wave dispatch returns — raising k
+        # trades TTFT for tunnel amortization.
         chunk = serve_config.prefill_chunk
         n_chunks = max(0, -(-(ref_prompt_len - 1) // chunk))
-        ttft_us = round(n_chunks * chunk_us + itl_us, 3)
+        ttft_us = round(n_chunks * chunk_us + waves * itl_us, 3)
     record: dict[str, Any] = {
         "device_kind": device.kind,
         "model_family": label,
@@ -719,21 +841,34 @@ def audit_serving(
         "num_blocks": int(spec.num_blocks),
         "block_len": int(spec.block_len),
         "prefill_chunk": int(serve_config.prefill_chunk),
+        "waves_per_dispatch": int(waves),
         "ref_prompt_len": int(ref_prompt_len),
         "predicted_itl_us": itl_us,
         "prefill_chunk_us": chunk_us,
         "predicted_ttft_us": ttft_us,
         "itl_floor_us": round(floor / device.hbm_bw * 1e6, 3),
         "decode_floor_bytes": int(floor),
+        "byte_model": "fused-paged" if kernel_engages else "compiled-xla",
         "decode_traffic_bytes": (
-            decode.wave_hbm_bytes if decode else None
+            int(priced_bytes) if priced_bytes else None
+        ),
+        "fused_decode_bytes": int(fused),
+        "xla_traffic_bytes": (
+            wave.wave_hbm_bytes if wave else None
         ),
         "overfetch_ratio": (
-            round(decode.wave_hbm_bytes / floor, 2)
-            if decode and floor else None
+            round(wave.wave_hbm_bytes / floor, 2)
+            if wave and floor else None
+        ),
+        # The one device_get fetches the whole k-wave dispatch's output;
+        # per-wave is the k-normalized figure so the metric stays
+        # comparable across targets with different k.
+        "host_bytes_per_dispatch": (
+            decode.non_aliased_output_bytes if decode else None
         ),
         "host_bytes_per_wave": (
-            decode.non_aliased_output_bytes if decode else None
+            round(decode.non_aliased_output_bytes / waves, 1)
+            if decode else None
         ),
         "programs": {
             p.name: {
@@ -758,9 +893,14 @@ def audit_serving(
             "chunks": sum(1 for o in observations if o.program == "prefill"),
         },
     }
-    if decode is not None:
+    if wave is not None:
+        # RKT602 audits the COMPILED single-wave program's traffic — the
+        # XLA gather path every backend can fall back to. The fused
+        # kernel's modeled bytes sit near the floor by construction;
+        # what can regress (lost fusion, a widened transient, a fat pool
+        # dtype) shows up in the compiled program.
         findings.extend(check_decode_roofline(
-            decode.wave_hbm_bytes, floor, overfetch_ratio=overfetch_ratio,
+            wave.wave_hbm_bytes, floor, overfetch_ratio=overfetch_ratio,
             label=label,
         ))
 
@@ -837,6 +977,7 @@ def _charlm_serve_parts():
     )
     return TransformerLM(config), ServeConfig(
         max_slots=8, block_len=16, prefill_chunk=32, max_model_len=256,
+        decode_waves_per_dispatch=4,
     )
 
 
@@ -855,6 +996,7 @@ def _gpt2_geom_serve_parts():
     )
     return TransformerLM(config), ServeConfig(
         max_slots=8, block_len=32, prefill_chunk=64, max_model_len=512,
+        decode_waves_per_dispatch=4,
     )
 
 
@@ -889,30 +1031,31 @@ SERVE_TARGETS: dict[str, ServeTarget] = {}
 
 def _register_targets():
     for target in (
-        # Ceilings = today's wave-roofline predictions (tiny 2.2/7.9us,
-        # charlm 126/436us, gpt2_geom 170/353us on v5e) + ~40-50%
-        # headroom: cost-model noise passes, a structural decode-path
-        # regression does not.
+        # Ceilings = today's fused-byte-model roofline predictions
+        # (tiny 1.2/6.9us, charlm 27/419us, gpt2_geom 58/414us on v5e)
+        # + ~40-50% headroom: cost-model noise passes, a structural
+        # decode-path regression (the kernel's active-pages byte model
+        # widening back toward the XLA gather's transient) does not.
         ServeTarget(
             name="tiny",
             build=_tiny_serve_parts,
             ref_prompt_len=48,
-            itl_ceiling_us=4.0,
-            ttft_ceiling_us=14.0,
+            itl_ceiling_us=2.0,
+            ttft_ceiling_us=11.0,
         ),
         ServeTarget(
             name="charlm",
             build=_charlm_serve_parts,
             ref_prompt_len=64,
-            itl_ceiling_us=190.0,
-            ttft_ceiling_us=650.0,
+            itl_ceiling_us=42.0,
+            ttft_ceiling_us=600.0,
         ),
         ServeTarget(
             name="gpt2_geom",
             build=_gpt2_geom_serve_parts,
             ref_prompt_len=128,
-            itl_ceiling_us=250.0,
-            ttft_ceiling_us=530.0,
+            itl_ceiling_us=85.0,
+            ttft_ceiling_us=600.0,
         ),
         ServeTarget(
             name="badserve",

@@ -9,39 +9,65 @@ layout, arXiv 2309.06180). Thousands of concurrent sequences then share
 ``num_blocks * block_bytes`` of HBM regardless of how many are admitted —
 the pool is allocated once and only the tables change.
 
-This module is the device-side math, written as plain XLA gather/scatter
-so it runs (and is tested) on any backend:
+Two device-side implementations share one signature:
 
-* :func:`write_kv_pages` scatters a chunk's new K/V rows into the pool at
-  ``block_table[pos // block_len] * block_len + pos % block_len``. Rows
-  masked out by ``valid`` (prompt padding, inactive slots) are routed to
-  the RESERVED trash block 0, which the allocator never hands out — the
-  compiled step thus has one fixed shape for every admission state.
-* :func:`paged_attention` writes first, then gathers each slot's mapped
-  blocks back to a contiguous ``(S, T, Hkv, D)`` context and runs
-  causally-masked GQA attention with f32 softmax statistics over it, in
-  the feature-major layout (no head transposes — same reasoning as
-  ``ops/flash_native.py``).
+* **XLA path** (portable — every backend): :func:`write_kv_pages`
+  scatters the chunk's new K/V rows into the pool, then the mapped
+  blocks are gathered back to a contiguous ``(S, T, Hkv, D)`` context
+  and causally-masked GQA attention runs over it in the feature-major
+  layout. The gather materializes a transient
+  ``(max_slots, max_blocks_per_seq * block_len, Hkv, D)`` context per
+  wave — the 4.6x decode overfetch RKT602 measured against the analytic
+  floor.
+* **pallas paged-decode kernel** (TPU, C=1 decode waves): the same
+  scatter, then gather and attend are FUSED per block-table page —
+  each grid step streams one ``(block_kv, D)`` tile of one mapped page
+  straight into VMEM and folds it into a flash-style running softmax,
+  so only the slot's ACTIVE pages ever leave HBM and no transient
+  context materializes. Inactive table entries point at the reserved
+  trash block 0; Mosaic's pipeline skips re-fetching a repeated block
+  index, so the dead tail of a short sequence costs at most one trash
+  PAGE of fetches (``block_len / block_kv`` tiles, cycled thereafter),
+  not ``max_blocks_per_seq`` gathers.
+
+Implementation choice and the ``block_kv`` tile height resolve through
+the ``paged_decode`` tune table (``rocket_tpu.tune``) — ``impl`` is a
+real structural search axis (the tuner can measure the XLA path beating
+the kernel on a shape and pin it). With no table entry the kernel is the
+TPU default and **CPU falls back to the XLA path** (bitwise identical to
+an untuned checkout — asserted in tests); ``ROCKET_TPU_PAGED_DECODE``
+(``pallas``/``xla``) force-overrides both for operational escape.
 
 Layout notes for TPU: D stays the minor (lane) dimension end-to-end and
-``block_len`` should be a multiple of 8 (sublane tile) — the pool then
-tiles like the dense ``(B, Hkv, T, D)`` cache does. The gather
-materializes a transient ``(S, T, Hkv, D)`` context per wave (bounded by
-``max_slots * max_blocks_per_seq * block_len``); a pallas kernel that
-streams blocks VMEM-resident like ``ops/decode_attention.py`` is the
-known follow-up and slots in behind this exact signature.
+``block_len`` should be a multiple of the dtype's sublane tile (8 f32 /
+16 bf16) — shapes that violate this fall back to the XLA path.
 
 Inference only (no custom VJP — serving never differentiates).
 """
 
 from __future__ import annotations
 
+import functools
 import math
+import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["write_kv_pages", "paged_attention", "paged_gather"]
+__all__ = [
+    "write_kv_pages",
+    "paged_attention",
+    "paged_gather",
+    "paged_decode_supported",
+]
+
+_NEG_INF = -1e30
+
+#: Sublane minimum per itemsize — mirrors ``tune.space.sublane_min``.
+_SUBLANE = {4: 8, 2: 16, 1: 32}
 
 
 def write_kv_pages(k_pages, v_pages, block_table, positions, valid, k_new, v_new):
@@ -87,49 +113,143 @@ def paged_gather(pages, block_table):
     return ctx.reshape((s, mb * bl) + pages.shape[2:])
 
 
-def paged_attention(q, k_new, v_new, k_pages, v_pages, block_table,
-                    positions, valid):
-    """One chunk of causal GQA attention against the paged pool.
+def paged_decode_supported(block_len: int, head_dim: int, itemsize: int = 4) -> bool:
+    """Shape gate for the fused kernel: pool pages must tile as
+    ``(block_len, D)`` VMEM blocks — block_len a multiple of the dtype's
+    sublane minimum and D a multiple of 8 (D is the whole minor dim, so
+    any such D is Mosaic-legal, same reasoning as
+    ``ops/decode_attention.py``)."""
+    sub = _SUBLANE.get(itemsize, 8)
+    return block_len % sub == 0 and head_dim % 8 == 0 and head_dim >= 8
 
-    ``q`` ``(S, C, Hq, D)``; ``k_new``/``v_new`` ``(S, C, Hkv, D)`` (RoPE
-    already applied); pool/table/positions/valid as in
-    :func:`write_kv_pages`. The chunk's rows are written into the pool
-    FIRST, then each query row ``i`` attends over the gathered context at
-    key positions ``<= positions[s] + i`` — exact prefix semantics at any
-    chunk size (C=1 decode and C=chunk prefill share this one code path,
-    which is what makes chunked prefill bit-match one-shot prefill).
 
-    Returns ``(out (S, C, Hq*D), k_pages', v_pages')``. Padded query rows
-    (``i >= valid[s]``) produce well-defined garbage (position 0 is always
-    visible, so the softmax never sees an all-masked row) — callers ignore
-    them.
-    """
+def _default_block_kv(block_len: int, itemsize: int = 4) -> int:
+    """The hand-picked tile height: the largest power-of-two row count
+    (<= 128) that divides the page — one page per grid step when the
+    page itself is small."""
+    sub = _SUBLANE.get(itemsize, 8)
+    for rows in (128, 64, 32, 16, 8):
+        if rows % sub == 0 and block_len % rows == 0:
+            return rows
+    return block_len
+
+
+def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_kv, sub, mb, scale):
+    """One (slot, kv-head, kv-tile) grid step of the fused paged decode.
+
+    Streams a ``(block_kv, D)`` tile of the mapped page and folds it
+    into the flash-style running softmax held in f32 scratch; the
+    normalized output is written once, after the last tile. The new
+    K/V row was scattered into the pool BEFORE the kernel, so key
+    positions ``<= pos`` (the query's own row included) are all read
+    from the pool — exact prefix semantics, one code path."""
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    pos = pos_ref[i]
+    n_ctx = pos + 1                       # visible keys: positions [0, pos]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    base = j * block_kv                   # global position of tile row 0
+
+    @pl.when(base < n_ctx)
+    def _tile():
+        q = q_ref[0]                      # (g, D)
+        k = k_ref[0, :, 0, :]             # (block_kv, D)
+        v = v_ref[0, :, 0, :]
+        s_ij = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                         # (g, block_kv) f32
+        idx = base + jax.lax.broadcasted_iota(jnp.int32, s_ij.shape, 1)
+        s_ij = jnp.where(idx < n_ctx, s_ij, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]            # (g, 1)
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_ij, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)   # (g, 1)
+        p = jnp.exp(s_ij - m_new)         # (g, block_kv)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == sub * mb - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[...] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, block_table, positions,
+                         *, block_kv: int, interpret: bool):
+    """The fused gather+attend for one decode wave: ``q`` (S, Hq, D),
+    pool/table/positions as in :func:`paged_attention` (new rows already
+    scattered). Returns ``out`` (S, Hq, D)."""
+    s, hq, d = q.shape
+    nb, bl, h_kv, _ = k_pages.shape
+    mb = block_table.shape[1]
+    g = hq // h_kv
+    sub = bl // block_kv
+    scale = 1.0 / math.sqrt(d)
+
+    def q_map(i, h, j, table_ref, pos_ref):
+        del j, table_ref, pos_ref
+        return (i, h, 0)
+
+    def page_map(i, h, j, table_ref, pos_ref):
+        del pos_ref
+        # Block units: dim 1 is tiled at block_kv rows, so a page's
+        # tile t sits at block index (block_id * sub + t) — except dim 0
+        # is blocked at 1 whole page, so the page id IS the dim-0 index
+        # and the within-page tile is the dim-1 index.
+        return (table_ref[i * mb + j // sub], j % sub, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, h_kv, mb * sub),
+        in_specs=[
+            pl.BlockSpec((1, g, d), q_map),
+            pl.BlockSpec((1, block_kv, 1, d), page_map),
+            pl.BlockSpec((1, block_kv, 1, d), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, g, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max (lane-bcast)
+            pltpu.VMEM((g, 128), jnp.float32),   # running denom
+            pltpu.VMEM((g, d), jnp.float32),     # unnormalized accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_kv=block_kv, sub=sub, mb=mb, scale=scale
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hq, d), q.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table.reshape(-1).astype(jnp.int32),
+      jnp.asarray(positions, jnp.int32), q, k_pages, v_pages)
+
+
+def _attend_xla(q, k_pages, v_pages, block_table, positions, valid):
+    """The portable gather+attend: contiguous per-slot context, einsum
+    attention with f32 softmax statistics. ``q`` (S, C, Hq, D); returns
+    ``out`` (S, C, Hq*D). Exactly the pre-kernel implementation — the
+    proven-bitwise-identical CPU fallback."""
+    del valid  # padded rows produce well-defined garbage; callers ignore
     s, c, hq, d = q.shape
     h_kv = k_pages.shape[2]
-    if hq % h_kv:
-        raise ValueError(f"paged_attention: Hq {hq} not a multiple of Hkv {h_kv}")
     g = hq // h_kv
-    # Tunable surface (tune kernel "paged_decode"): the XLA gather path
-    # is the only variant today; the axis gains candidates when the
-    # VMEM-streaming pallas kernel lands behind this signature (module
-    # docstring). The lookup also records serving-path config provenance
-    # for BENCH_DETAIL.
-    from rocket_tpu.tune import get_config
-
-    config = get_config(
-        "paged_decode",
-        shape={"bl": int(k_pages.shape[1]), "d": d, "hkv": h_kv},
-        dtype=k_pages.dtype,
-    )
-    variant = (config or {}).get("variant", "gather")
-    if variant != "gather":
-        raise ValueError(
-            f"paged_attention: unknown tuned variant {variant!r} — the "
-            "table is ahead of the implementation"
-        )
-    k_pages, v_pages = write_kv_pages(
-        k_pages, v_pages, block_table, positions, valid, k_new, v_new
-    )
     k_ctx = paged_gather(k_pages, block_table)          # (S, T, Hkv, D)
     v_ctx = paged_gather(v_pages, block_table)
     t = k_ctx.shape[1]
@@ -144,7 +264,94 @@ def paged_attention(q, k_new, v_new, k_pages, v_pages, block_table,
     mask = key_pos[None, None, :] <= q_pos[:, :, None]  # (S, C, T)
     logits = jnp.where(mask[:, None, None, :, :], logits, -jnp.inf)
     weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum(
+    return jnp.einsum(
         "skgct,stkd->sckgd", weights.astype(v_ctx.dtype), v_ctx
     ).reshape(s, c, hq * d)
+
+
+def paged_attention(q, k_new, v_new, k_pages, v_pages, block_table,
+                    positions, valid, *, impl: Optional[str] = None,
+                    block_kv: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """One chunk of causal GQA attention against the paged pool.
+
+    ``q`` ``(S, C, Hq, D)``; ``k_new``/``v_new`` ``(S, C, Hkv, D)`` (RoPE
+    already applied); pool/table/positions/valid as in
+    :func:`write_kv_pages`. The chunk's rows are written into the pool
+    FIRST, then each query row ``i`` attends over key positions
+    ``<= positions[s] + i`` — exact prefix semantics at any chunk size
+    (C=1 decode and C=chunk prefill share this one signature, which is
+    what makes chunked prefill bit-match one-shot prefill).
+
+    ``impl``/``block_kv`` pin the implementation explicitly (the tuner's
+    candidate runs); left ``None`` they resolve through the
+    ``paged_decode`` tune table, defaulting to the fused pallas kernel
+    for C=1 decode on TPU and the XLA path everywhere else.
+    ``interpret=True`` runs the kernel interpreted (CPU parity tests).
+
+    Returns ``(out (S, C, Hq*D), k_pages', v_pages')``. Padded query rows
+    (``i >= valid[s]``) produce well-defined garbage (position 0 is always
+    visible, so the softmax never sees an all-masked row) — callers ignore
+    them.
+    """
+    s, c, hq, d = q.shape
+    bl = int(k_pages.shape[1])
+    h_kv = int(k_pages.shape[2])
+    mb = int(block_table.shape[1])
+    if hq % h_kv:
+        raise ValueError(f"paged_attention: Hq {hq} not a multiple of Hkv {h_kv}")
+    itemsize = jnp.dtype(k_pages.dtype).itemsize
+    if (impl is None or block_kv is None) and c == 1:
+        # Tunable surface (tune kernel "paged_decode"): impl is a REAL
+        # structural axis (fused pallas kernel vs XLA gather) and
+        # block_kv the streamed tile height; the lookup also records
+        # serving-path config provenance for BENCH_DETAIL. Prefill
+        # chunks (C > 1) skip it entirely — the axes cannot affect them
+        # (always the XLA path), so they must not pollute the
+        # provenance log with inert rows.
+        from rocket_tpu.tune import get_config
+
+        config = get_config(
+            "paged_decode",
+            shape={"s": s, "mb": mb, "bl": bl, "hkv": h_kv, "hq": hq,
+                   "d": d},
+            dtype=k_pages.dtype,
+        ) or {}
+        if impl is None:
+            impl = os.environ.get("ROCKET_TPU_PAGED_DECODE") \
+                or config.get("impl", "pallas")
+        if block_kv is None:
+            block_kv = config.get("block_kv") \
+                or _default_block_kv(bl, itemsize)
+    impl = impl or "xla"
+    block_kv = block_kv or _default_block_kv(bl, itemsize)
+    if impl not in ("pallas", "xla"):
+        raise ValueError(
+            f"paged_attention: unknown impl {impl!r} — the table is "
+            "ahead of the implementation (expected 'pallas' or 'xla')"
+        )
+
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, block_table, positions, valid, k_new, v_new
+    )
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    use_pallas = (
+        impl == "pallas"
+        and c == 1
+        and paged_decode_supported(bl, d, itemsize)
+        and (not on_cpu or bool(interpret))
+    )
+    if use_pallas:
+        if block_kv % _SUBLANE.get(itemsize, 8) or bl % block_kv:
+            raise ValueError(
+                f"paged_attention: block_kv={block_kv} must be a "
+                f"multiple of the sublane tile dividing block_len={bl}"
+            )
+        out = _paged_decode_pallas(
+            q[:, 0], k_pages, v_pages, block_table, positions,
+            block_kv=int(block_kv), interpret=on_cpu or bool(interpret),
+        ).reshape(s, 1, hq * d)
+        return out, k_pages, v_pages
+    out = _attend_xla(q, k_pages, v_pages, block_table, positions, valid)
     return out, k_pages, v_pages

@@ -122,6 +122,7 @@ def _run(args) -> int:
             num_blocks=args.num_blocks,
             max_model_len=args.max_model_len,
             prefill_chunk=args.prefill_chunk,
+            decode_waves_per_dispatch=args.waves_per_dispatch,
         ),
         tokenizer=tokenizer,
         telemetry=telemetry,
@@ -217,6 +218,10 @@ def main(argv=None) -> int:
         p.add_argument("--num-blocks", type=int, default=None)
         p.add_argument("--max-model-len", type=int, default=None)
         p.add_argument("--prefill-chunk", type=int, default=16)
+        p.add_argument("--waves-per-dispatch", type=int, default=1,
+                       help="decode waves per device dispatch (k): one "
+                       "compiled scan of k waves amortizes the dispatch "
+                       "tunnel over k tokens per slot")
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--show", type=int, default=2,
                        help="stream the first N requests to stdout")

@@ -46,19 +46,28 @@ class ServeConfig:
     prefill_chunk: int = 16
     #: Pool dtype. Default: the model's activation dtype (or f32).
     dtype: Optional[str] = None
+    #: Decode waves per device dispatch (k): one compiled ``lax.scan``
+    #: of k waves amortizes the host→device dispatch tunnel and the one
+    #: ``jax.device_get`` over k tokens per slot. Raising k multiplies
+    #: steady-state tokens-per-dispatch but adds up to k-1 wave times to
+    #: TTFT and makes the scheduler react to EOS/admission every k
+    #: tokens — docs/serving.md ("when to raise k") has the tradeoff.
+    decode_waves_per_dispatch: int = 1
     #: Completed Request records retained for ``result()``/``stream()``
     #: readers; beyond this the OLDEST finished requests are dropped so a
     #: long-running server's host memory stays bounded (``release()``
     #: drops one eagerly).
     max_completed_requests: int = 4096
 
-    def resolve(self, model_config) -> tuple[KVPoolSpec, int, int]:
-        """``(pool_spec, max_blocks_per_seq, num_blocks)`` for a model.
+    def resolve(self, model_config) -> tuple[KVPoolSpec, int, int, int]:
+        """``(pool_spec, max_blocks_per_seq, num_blocks,
+        waves_per_dispatch)`` for a model.
 
         THE sizing math — one implementation shared by the live engine
         and the static serving auditor
-        (``rocket_tpu.analysis.serve_audit``), so the audited pool is
-        byte-identical to the served one."""
+        (``rocket_tpu.analysis.serve_audit``), so the audited pool AND
+        the audited k-wave program are byte-identical to the served
+        ones."""
         mc = model_config
         h_kv = mc.num_kv_heads or mc.num_heads
         max_len = self.max_model_len or mc.max_seq_len
@@ -66,6 +75,11 @@ class ServeConfig:
             raise ValueError(
                 f"ServeConfig.max_model_len {max_len} exceeds the model's "
                 f"max_seq_len {mc.max_seq_len}"
+            )
+        waves = int(self.decode_waves_per_dispatch)
+        if waves < 1:
+            raise ValueError(
+                f"ServeConfig.decode_waves_per_dispatch {waves} < 1"
             )
         mb = -(-max_len // self.block_len)  # ceil: blocks per sequence
         num_blocks = self.num_blocks or (1 + self.max_slots * mb)
@@ -77,7 +91,7 @@ class ServeConfig:
             head_dim=mc.dim // mc.num_heads,
             dtype=self.dtype or mc.activation_dtype or "float32",
         )
-        return spec, mb, num_blocks
+        return spec, mb, num_blocks, waves
 
 
 class StreamDetokenizer:
@@ -130,13 +144,14 @@ class ServeEngine:
         key=None,
     ) -> None:
         cfg = config or ServeConfig()
-        spec, mb, num_blocks = cfg.resolve(model.config)
+        spec, mb, num_blocks, waves = cfg.resolve(model.config)
         self.config = cfg
         self.engine = SlotEngine(
             model, params, spec,
             max_slots=cfg.max_slots,
             max_blocks_per_seq=mb,
             prefill_chunk=cfg.prefill_chunk,
+            waves_per_dispatch=waves,
             key=key,
         )
         self.scheduler = Scheduler(self.engine, BlockAllocator(num_blocks))
@@ -160,6 +175,15 @@ class ServeEngine:
         self._last_event_at: Optional[float] = None
         self._occupancy_sum = 0
         self._ticks = 0
+        # Host-overlap accounting: wall-clock inside step() vs the slice
+        # of it spent blocked on the device fetch (engine.harvest_wait_s)
+        # — the difference is host work that OVERLAPPED the in-flight
+        # dispatch. Baselines let reset_metrics() window the engine-side
+        # cumulative counters to the steady state.
+        self._step_wall_s = 0.0
+        self._base_harvest_wait_s = 0.0
+        self._base_device_gets = 0
+        self._base_dispatches = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -199,29 +223,55 @@ class ServeEngine:
     def step(self) -> list[TickEvent]:
         """One scheduling round; records latency metrics and publishes the
         obs gauges. Serialized under the engine lock — concurrent
-        ``stream()`` readers may each drive ``step()``."""
+        ``stream()`` readers may each drive ``step()``.
+
+        With ``decode_waves_per_dispatch`` > 1 a request's k tokens of
+        one dispatch land in the same harvest, so inter-token latency is
+        AMORTIZED: each of the n tokens a request receives this step
+        contributes ``(now - previous emit) / n`` — the per-token cadence
+        the k-wave scan actually delivers, which is what the static
+        roofline's predicted ITL models. A request's very first batch
+        contributes only its TTFT (there is no previous emit to span)."""
         with self._lock:
+            t0 = time.perf_counter()
+            gets_before = self.engine.device_gets
             events = self.scheduler.tick()
             self._ticks += 1
             self._occupancy_sum += self.scheduler.active_slots
             now = time.perf_counter()
+            if self.engine.device_gets > gets_before:
+                # Overlap accounting only for ticks that actually
+                # harvested a dispatch — idle polling and the fringe
+                # ticks around a burst would otherwise inflate
+                # host_overlap_fraction toward 1.0 with no dispatch in
+                # flight to overlap.
+                self._step_wall_s += now - t0
             if events:
                 if self._first_wave_at is None:
                     self._first_wave_at = now
                 self._last_event_at = now
+            batch: dict[int, int] = {}
+            for ev in events:
+                batch[ev.request.id] = batch.get(ev.request.id, 0) + 1
+            seen: dict[int, int] = {}
             for ev in events:
                 req = ev.request
                 prev = self._last_emit.get(req.id)
+                first_of_batch = req.id not in seen
+                seen[req.id] = seen.get(req.id, 0) + 1
                 if prev is None:
-                    self._ttft.append(req.first_token_at - req.submitted_at)
+                    if first_of_batch:
+                        self._ttft.append(
+                            req.first_token_at - req.submitted_at
+                        )
                 else:
-                    # Inter-token latency: the wave cadence this request saw.
-                    self._itl.append(now - prev)
+                    # Amortized inter-token latency for this batch.
+                    self._itl.append((now - prev) / batch[req.id])
                 if ev.finished:
                     self._last_emit.pop(req.id, None)
                     self._finish_span(req)
                     self._retire_locked(req.id)
-                else:
+                elif seen[req.id] == batch[req.id]:
                     self._last_emit[req.id] = now
             del self._ttft[:-self._latency_cap]
             del self._itl[:-self._latency_cap]
@@ -328,6 +378,11 @@ class ServeEngine:
         # The compiled-once proof, surfaced where telemetry.json lands it.
         reg.gauge("serve/decode_traces").set(self.engine.decode_traces)
         reg.gauge("serve/prefill_traces").set(self.engine.prefill_traces)
+        # Tunnel amortization: host syncs vs waves (ISSUE 11 k-wave scan).
+        reg.gauge("serve/decode_dispatches").set(
+            self.engine.decode_dispatches
+        )
+        reg.gauge("serve/device_gets").set(self.engine.device_gets)
 
     def reset_metrics(self) -> None:
         """Zero the latency/throughput aggregates — NOT the compile-trace
@@ -342,6 +397,10 @@ class ServeEngine:
             self._last_event_at = None
             self._occupancy_sum = 0
             self._ticks = 0
+            self._step_wall_s = 0.0
+            self._base_harvest_wait_s = self.engine.harvest_wait_s
+            self._base_device_gets = self.engine.device_gets
+            self._base_dispatches = self.engine.decode_dispatches
             sched = self.scheduler
             sched.submitted = sched.queue_depth + sched.active_slots
             sched.completed = 0
@@ -356,6 +415,30 @@ class ServeEngine:
         concurrent ``step()``/``reset_metrics()`` is never torn."""
         with self._lock:
             return self._report_locked()
+
+    def _dispatch_stats_locked(self) -> dict:
+        """Tunnel-amortization accounting since the last
+        ``reset_metrics()``: decoded tokens per device dispatch, host
+        syncs, and the fraction of host step time that OVERLAPPED the
+        in-flight dispatch (1 - harvest-blocked / step wall)."""
+        eng = self.engine
+        gets = eng.device_gets - self._base_device_gets
+        dispatches = eng.decode_dispatches - self._base_dispatches
+        wait = eng.harvest_wait_s - self._base_harvest_wait_s
+        tokens = self.scheduler.tokens_generated
+        return {
+            "waves_per_dispatch": eng.waves_per_dispatch,
+            "decode_dispatches": dispatches,
+            "device_get_count": gets,
+            "tokens_per_dispatch": (
+                round(tokens / dispatches, 3) if dispatches else None
+            ),
+            "harvest_wait_s": round(wait, 6),
+            "host_overlap_fraction": (
+                round(max(0.0, 1.0 - wait / self._step_wall_s), 4)
+                if self._step_wall_s > 0 else None
+            ),
+        }
 
     def _report_locked(self) -> dict:
         sched = self.scheduler
@@ -381,6 +464,7 @@ class ServeEngine:
                 "decode_waves": self.engine.decode_waves,
                 "prefill_chunks": self.engine.prefill_chunks,
             },
+            "dispatch": self._dispatch_stats_locked(),
             "pool": {
                 "num_blocks": self.engine.spec.num_blocks,
                 "block_len": self.engine.spec.block_len,

@@ -2,11 +2,18 @@
 
 Exactly TWO jit-compiled programs serve the whole request lifecycle:
 
-* the **decode wave**: one token for every slot in ``[0, max_slots)`` —
-  paged attention against the shared block pool, per-slot sampling with
-  the knobs (temperature / top-k / top-p / EOS / length limit) as RUNTIME
-  arrays, and an active-mask so empty/prefilling slots cost shape space
-  but never semantics;
+* the **decode wave scan**: ``waves_per_dispatch`` (k) decode waves in
+  ONE compiled program — a ``lax.scan`` whose carry threads the pool
+  buffers, per-slot lengths, last tokens and the on-device done/run
+  mask, so one host→device dispatch and ONE ``jax.device_get`` amortize
+  over k tokens per slot. Each wave is one token for every slot in
+  ``[0, max_slots)``: paged attention against the shared block pool,
+  per-slot sampling with the knobs (temperature / top-k / top-p / EOS /
+  length limit) as RUNTIME arrays, and the carried run mask freezing a
+  slot the wave after it emits EOS or hits its limit — mid-scan
+  finishes emit nothing further (the early-exit mask; a dispatch whose
+  slots ALL finish early still executes its remaining waves, but they
+  write only to the reserved trash block);
 * the **prefill chunk**: a fixed-size ``(1, prefill_chunk)`` prompt slice
   through the same ``decode_step_paged`` code path, padded + masked at
   the tail, so a prompt of ANY length runs through one compiled program
@@ -30,15 +37,18 @@ fake backend and prove the retrace/HBM/latency story before any request
 is served.
 
 Pool buffers are DONATED through both programs (:data:`DECODE_DONATE` /
-:data:`PREFILL_DONATE`), so the pool is updated in place wave over wave;
-the one host sync per wave is the explicit ``jax.device_get`` of the
-sampled tokens — serving has to observe them to stream, and it is a few
-hundred bytes.
+:data:`PREFILL_DONATE`), so the pool is updated in place wave over wave.
+The scan SPLITS dispatch from harvest: :meth:`SlotEngine.decode_dispatch`
+enqueues the k-wave program and returns immediately with device handles,
+:meth:`SlotEngine.harvest` performs the one explicit ``jax.device_get``
+— the scheduler dispatches wave N, then admits/prefills/detokenizes
+wave N−1's results while N runs (dispatch-then-harvest pipelining).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import time
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +58,7 @@ from rocket_tpu.serve.kv_pool import KVPoolSpec
 
 __all__ = [
     "SlotEngine",
+    "WaveHandle",
     "build_decode_wave",
     "build_prefill_step",
     "abstract_wave_inputs",
@@ -62,37 +73,81 @@ DECODE_DONATE = (1, 2)
 PREFILL_DONATE = (1, 2)
 
 
-def build_decode_wave(model, on_trace: Optional[Callable] = None) -> Callable:
-    """The decode-wave step function for ``model`` — PURE in its
-    arguments (params and pool buffers are inputs, not closure state).
+class WaveHandle(NamedTuple):
+    """An in-flight k-wave dispatch: device arrays, fetched (ONE
+    ``jax.device_get``) by :meth:`SlotEngine.harvest`. All are
+    ``(waves_per_dispatch, max_slots)``: the sampled token per wave, the
+    finished flag the wave raised, and whether the slot actually ran
+    that wave (a slot frozen mid-scan stops emitting)."""
+
+    tokens: jax.Array    # (k, S) int32
+    done: jax.Array      # (k, S) bool
+    emitted: jax.Array   # (k, S) bool
+
+
+def build_decode_wave(model, on_trace: Optional[Callable] = None,
+                      waves: int = 1) -> Callable:
+    """The k-wave decode program for ``model`` — PURE in its arguments
+    (params and pool buffers are inputs, not closure state).
+
+    ``waves`` (k) is baked into the trace: a ``lax.scan`` of k decode
+    waves whose carry threads (pool, lengths, last token, run mask), so
+    the per-slot sampling salt — ``seeds * 1000003 + lengths``, int32 —
+    derives ON DEVICE each wave and a slot that finishes mid-scan is
+    frozen by the carried mask (its later waves hold the token, route
+    their pool writes to the trash block, and emit nothing). k=1 is the
+    same scan of length one — one code path, and greedy outputs are
+    bit-identical for every k by construction (the per-wave math never
+    reads k).
 
     ``on_trace`` is invoked at TRACE time inside the body (the engine
     passes its retrace counter; the auditor passes its own). Signature::
 
         decode_wave(params, k_pages, v_pages, block_table, lengths,
                     last_tok, run_mask, limits, temp, top_k, top_p,
-                    eos, salts, key) -> (k_pages, v_pages, next, done)
+                    eos, seeds, key)
+            -> (k_pages, v_pages, tokens (k, S), done (k, S),
+                emitted (k, S))
     """
+    k = int(waves)
+    if k < 1:
+        raise ValueError(f"build_decode_wave: waves {k} < 1")
 
     def decode_wave(params, k_pages, v_pages, block_table, lengths,
                     last_tok, run_mask, limits, temp, top_k, top_p,
-                    eos, salts, key):
+                    eos, seeds, key):
         if on_trace is not None:
             on_trace()  # trace-time: counts (re)traces only
-        valid = run_mask.astype(jnp.int32)
-        logits, k_pages, v_pages = model.decode_step_paged(
-            params, last_tok[:, None], k_pages, v_pages, block_table,
-            lengths, valid,
+
+        def one_wave(carry, _):
+            k_pages, v_pages, lengths, last_tok, run = carry
+            valid = run.astype(jnp.int32)
+            logits, k_pages, v_pages = model.decode_step_paged(
+                params, last_tok[:, None], k_pages, v_pages, block_table,
+                lengths, valid,
+            )
+            # Per-wave salt, derived on device so every wave of the scan
+            # samples exactly as k dispatched single waves would (int32
+            # wraparound is deterministic; fold_in takes any int32).
+            salts = seeds * jnp.int32(1000003) + lengths
+            nxt = sample_tokens(
+                logits, key, salts, temp, top_k, top_p
+            ).astype(jnp.int32)
+            done = jnp.zeros(nxt.shape, bool)
+            nxt, done = freeze_after_eos(nxt, done, eos)
+            done = done | (lengths + valid >= limits)
+            # Frozen/masked slots: hold their token (host state stays
+            # coherent) and emit nothing this wave.
+            nxt = jnp.where(run, nxt, last_tok)
+            done = done & run
+            carry = (k_pages, v_pages, lengths + valid, nxt, run & ~done)
+            return carry, (nxt, done, run)
+
+        init = (k_pages, v_pages, lengths, last_tok, run_mask)
+        (k_pages, v_pages, _, _, _), (toks, done, emitted) = jax.lax.scan(
+            one_wave, init, None, length=k
         )
-        nxt = sample_tokens(
-            logits, key, salts, temp, top_k, top_p
-        ).astype(jnp.int32)
-        done = jnp.zeros(nxt.shape, bool)
-        nxt, done = freeze_after_eos(nxt, done, eos)
-        done = done | (lengths + valid >= limits)
-        # Masked slots: hold their token (host state stays coherent).
-        nxt = jnp.where(run_mask, nxt, last_tok)
-        return k_pages, v_pages, nxt, done & run_mask
+        return k_pages, v_pages, toks, done, emitted
 
     return decode_wave
 
@@ -129,7 +184,9 @@ def abstract_wave_inputs(
 ):
     """``(decode_args, prefill_args)`` — ``ShapeDtypeStruct`` tuples
     matching the two step functions' signatures, for zero-FLOP AOT
-    compilation (``jax.jit(fn).lower(*args).compile()``).
+    compilation (``jax.jit(fn).lower(*args).compile()``). The decode
+    signature is k-invariant: ``waves`` only changes the program body
+    (the scan length), never its inputs.
 
     ``abs_params`` defaults to ``jax.eval_shape(model.init)['params']``
     run through the same activation-dtype master-cast the engine applies
@@ -165,7 +222,7 @@ def abstract_wave_inputs(
         vec_i,                                # top_k
         vec_f,                                # top_p
         vec_i,                                # eos
-        vec_i,                                # salts
+        vec_i,                                # seeds
         key,
     )
     prefill_args = (
@@ -185,7 +242,9 @@ class SlotEngine:
     (or anything exposing ``decode_step_paged`` with the same signature);
     ``params`` its param tree — float leaves are cast ONCE to the model's
     activation dtype (the same hoisted master-cast ``generate()`` does:
-    decode is HBM-bound on parameter streaming).
+    decode is HBM-bound on parameter streaming). ``waves_per_dispatch``
+    (k) sets how many decode waves one compiled dispatch runs — the
+    tunnel-amortization knob (``ServeConfig.decode_waves_per_dispatch``).
     """
 
     def __init__(
@@ -197,6 +256,7 @@ class SlotEngine:
         max_slots: int,
         max_blocks_per_seq: int,
         prefill_chunk: int,
+        waves_per_dispatch: int = 1,
         key: Optional[jax.Array] = None,
     ) -> None:
         from rocket_tpu.models.transformer import _decode_params
@@ -206,11 +266,16 @@ class SlotEngine:
                 "SlotEngine: max_slots, max_blocks_per_seq and "
                 "prefill_chunk must all be >= 1"
             )
+        if waves_per_dispatch < 1:
+            raise ValueError(
+                f"SlotEngine: waves_per_dispatch {waves_per_dispatch} < 1"
+            )
         self.model = model
         self.spec = spec
         self.max_slots = int(max_slots)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.prefill_chunk = int(prefill_chunk)
+        self.waves_per_dispatch = int(waves_per_dispatch)
         self._params = _decode_params(params, model.config.activation_dtype)
         self.k_pages, self.v_pages = spec.init_pages()
         self._key = jax.random.key(0) if key is None else key
@@ -219,9 +284,16 @@ class SlotEngine:
         #: proof surfaced through the obs registry.
         self.decode_traces = 0
         self.prefill_traces = 0
-        #: Execution counters (host side, one per call).
+        #: Execution counters (host side). ``decode_waves`` counts WAVES
+        #: (k per dispatch); ``device_gets`` counts host syncs — the
+        #: smoke asserts one per dispatch, i.e. one per k tokens.
         self.decode_waves = 0
+        self.decode_dispatches = 0
+        self.device_gets = 0
         self.prefill_chunks = 0
+        #: Cumulative seconds :meth:`harvest` spent blocked on the
+        #: device fetch — what the host loop could NOT overlap.
+        self.harvest_wait_s = 0.0
 
         def count_decode():
             self.decode_traces += 1
@@ -230,7 +302,8 @@ class SlotEngine:
             self.prefill_traces += 1
 
         self._decode = jax.jit(
-            build_decode_wave(model, on_trace=count_decode),
+            build_decode_wave(model, on_trace=count_decode,
+                              waves=self.waves_per_dispatch),
             donate_argnums=DECODE_DONATE,
         )
         self._prefill = jax.jit(
@@ -240,19 +313,40 @@ class SlotEngine:
 
     # -- compiled-step drivers ---------------------------------------------
 
-    def decode(self, block_table, lengths, last_tok, run_mask, limits,
-               temp, top_k, top_p, eos, salts):
-        """One decode wave over every slot. All inputs are host arrays of
-        shape ``(max_slots, ...)`` with fixed dtypes (the scheduler's
-        mirrors); returns ``(next_tokens, done)`` as host numpy — the one
-        explicit device sync of the wave."""
-        self.decode_waves += 1
-        self.k_pages, self.v_pages, nxt, done = self._decode(
+    def decode_dispatch(self, block_table, lengths, last_tok, run_mask,
+                        limits, temp, top_k, top_p, eos, seeds) -> WaveHandle:
+        """Enqueue one k-wave decode dispatch over every slot. All inputs
+        are host arrays of shape ``(max_slots, ...)`` with fixed dtypes
+        (the scheduler's mirrors); returns a :class:`WaveHandle` of
+        device arrays WITHOUT synchronizing — the host keeps scheduling
+        while the device runs, and :meth:`harvest` fetches the results."""
+        self.decode_dispatches += 1
+        self.decode_waves += self.waves_per_dispatch
+        self.k_pages, self.v_pages, toks, done, emitted = self._decode(
             self._params, self.k_pages, self.v_pages, block_table, lengths,
-            last_tok, run_mask, limits, temp, top_k, top_p, eos, salts,
+            last_tok, run_mask, limits, temp, top_k, top_p, eos, seeds,
             self._key,
         )
-        return jax.device_get((nxt, done))
+        return WaveHandle(tokens=toks, done=done, emitted=emitted)
+
+    def harvest(self, handle: WaveHandle):
+        """Fetch one dispatch's results to host numpy — the single
+        explicit device sync per k decoded tokens. Returns
+        ``(tokens, done, emitted)`` as ``(k, S)`` numpy arrays."""
+        self.device_gets += 1
+        t0 = time.perf_counter()
+        out = jax.device_get(tuple(handle))
+        self.harvest_wait_s += time.perf_counter() - t0
+        return out
+
+    def decode(self, block_table, lengths, last_tok, run_mask, limits,
+               temp, top_k, top_p, eos, seeds):
+        """Dispatch-and-wait convenience (tests, simple drivers):
+        one k-wave dispatch harvested immediately."""
+        return self.harvest(self.decode_dispatch(
+            block_table, lengths, last_tok, run_mask, limits, temp,
+            top_k, top_p, eos, seeds,
+        ))
 
     def prefill(self, block_table_row, tokens, position, valid) -> None:
         """One prefill chunk for ONE slot: ``block_table_row`` ``(1, MB)``,

@@ -1,26 +1,44 @@
 """Continuous-batching request scheduler — the host-side policy half.
 
-Every ``tick()`` is one serving step:
+Every ``tick()`` is one serving step, PIPELINED against the in-flight
+device dispatch (dispatch-then-harvest):
 
 1. **admit** queued requests into free slots while the block pool can
    cover their prompts (all-or-nothing — a request never half-admits);
+   free slots were free at the previous dispatch, so admission never
+   touches a slot with results in flight;
 2. **prefill** one fixed-size chunk of the oldest still-prefilling slot
    (chunked prefill: long prompts trickle in a chunk per tick and never
-   stall the decode latency of running requests);
-3. **grow** each decode-ready slot's block table to cover the next token;
-   when the pool is exhausted the YOUNGEST active request is evicted —
-   its blocks return to the pool and it re-queues at the FRONT with its
-   generated tokens folded into the prompt, so it resumes exactly where
-   it stopped after re-prefill (back-pressure, never OOM);
-4. run ONE **decode wave** over all decode-ready slots;
-5. **harvest**: emitted tokens stream out, finished slots free their
-   blocks and are refillable on the very next tick.
+   stall the decode latency of running requests). Prefill is
+   fire-and-forget and still-prefilling slots are never in a decode
+   wave, so the chunk dispatch OVERLAPS the in-flight decode — the pool
+   buffers thread program-order through both, so dataflow serializes
+   them on device without a host sync;
+3. **harvest** the PREVIOUS tick's decode dispatch: one
+   ``jax.device_get`` fetches its k waves of tokens; emitted tokens
+   stream out, finished slots free their blocks and are refillable on
+   the very next tick;
+4. **grow** each decode-ready slot's block table to cover the next k
+   tokens; when the pool is exhausted the YOUNGEST active request is
+   evicted — its blocks return to the pool and it re-queues at the
+   FRONT with its generated tokens folded into the prompt, so it
+   resumes exactly where it stopped after re-prefill (back-pressure,
+   never OOM). Eviction runs strictly AFTER harvest, so a preempted
+   slot never has tokens in flight to lose;
+5. **dispatch** the next k-wave decode over all decode-ready slots and
+   return step 3's events — the caller detokenizes/streams them while
+   the new dispatch runs on device.
 
 The scheduler owns host-side numpy mirrors of every per-slot array the
 compiled wave consumes (block table, lengths, sampling vectors, masks).
 Admission/eviction mutate the mirrors only — shapes and dtypes are fixed
 at construction, which is what keeps the engine's compiled-once guarantee
-(asserted via the trace counters in ``serve/engine.py``).
+(asserted via the trace counters in ``serve/engine.py``). The pipelining
+invariant: between a dispatch and its harvest, the only mutations are
+admission into slots the dispatch did not run and prefill of slots the
+dispatch did not run — every mirror a dispatch read was copied to device
+at dispatch time, and harvest replays the device's own per-wave length
+bookkeeping onto the mirrors before anything else can read them.
 """
 
 from __future__ import annotations
@@ -114,6 +132,9 @@ class Scheduler:
         self.seeds = np.zeros((s,), np.int32)
         self.slots: list[Optional[_Slot]] = [None] * s
         self.queue: deque[Request] = deque()
+        #: The in-flight decode dispatch, harvested at the NEXT tick
+        #: (dispatch-then-harvest pipelining).
+        self.pending = None
         self._next_id = 0
         self._admit_seq = 0
         # Aggregates for the report / gauges.
@@ -168,28 +189,32 @@ class Scheduler:
     # -- the serving step --------------------------------------------------
 
     def tick(self) -> list[TickEvent]:
-        """One scheduling round: admit / prefill one chunk / grow tables
-        (evicting on exhaustion) / one decode wave / harvest. Returns the
-        tokens emitted this round; an idle engine returns []."""
+        """One scheduling round: admit / prefill one chunk / harvest the
+        in-flight dispatch / grow tables (evicting on exhaustion) /
+        dispatch the next k waves. Returns the tokens the HARVESTED
+        dispatch emitted (one tick behind the device — the pipelining);
+        an idle engine returns []."""
         self._admit()
         self._prefill_one()
+        events = self._harvest_pending()
         run = self._grow_tables()
-        if not run.any():
+        if run.any():
+            self.pending = self.engine.decode_dispatch(
+                self.block_table, self.lengths, self.last_tok, run,
+                self.limits, self.temp, self.top_k, self.top_p, self.eos,
+                self.seeds,
+            )
+        elif self.pending is None and not events:
             self.waves_idle += 1
-            return []
-        salts = (
-            (self.seeds.astype(np.int64) * 1000003 + self.lengths)
-            % np.int64(2**31)
-        ).astype(np.int32)
-        nxt, done = self.engine.decode(
-            self.block_table, self.lengths, self.last_tok, run, self.limits,
-            self.temp, self.top_k, self.top_p, self.eos, salts,
-        )
-        return self._harvest(run, nxt, done)
+        return events
 
     @property
     def idle(self) -> bool:
-        return not self.queue and all(s is None for s in self.slots)
+        return (
+            not self.queue
+            and all(s is None for s in self.slots)
+            and self.pending is None
+        )
 
     def run_until_idle(self, max_ticks: int = 100_000) -> list[TickEvent]:
         events = []
@@ -259,14 +284,25 @@ class Scheduler:
         self.lengths[slot] = st.prefill_pos
 
     def _grow_tables(self) -> np.ndarray:
-        """Cover position ``lengths[s]`` for every decode-ready slot,
-        evicting the youngest active request on pool exhaustion. Returns
-        the wave's run mask."""
+        """Cover every position the next dispatch may write — up to
+        ``waves_per_dispatch`` tokens per decode-ready slot, capped at
+        the slot's length limit — evicting the youngest active request
+        on pool exhaustion. Returns the dispatch's run mask. Runs only
+        with no dispatch in flight (tick() harvests first), so eviction
+        never strands in-flight tokens."""
+        k = self.engine.waves_per_dispatch
         run = np.zeros((self.engine.max_slots,), bool)
         for slot, st in enumerate(self.slots):
             if st is None or not st.prefill_done:
                 continue
-            need_idx = int(self.lengths[slot]) // self.block_len
+            # Highest row this dispatch can write: the k-th token lands
+            # at lengths + k - 1, and the final token ever lands at
+            # limits - 1 (see _admit's limit math).
+            last_pos = min(
+                int(self.lengths[slot]) + k - 1,
+                max(int(self.limits[slot]) - 1, int(self.lengths[slot])),
+            )
+            need_idx = last_pos // self.block_len
             while need_idx >= len(st.blocks):
                 got = self.allocator.alloc(1)
                 if got is None:
@@ -302,26 +338,36 @@ class Scheduler:
         self.queue.appendleft(st.req)
         self._clear(slot)
 
-    def _harvest(self, run: np.ndarray, nxt, done) -> list[TickEvent]:
+    def _harvest_pending(self) -> list[TickEvent]:
+        """Fetch the in-flight dispatch (ONE ``jax.device_get`` for its
+        k waves) and replay the device's per-wave bookkeeping onto the
+        host mirrors: every emitted token appends to its request and
+        advances the slot's length; a slot whose ``done`` flag rose
+        frees its blocks and is refillable next tick."""
+        if self.pending is None:
+            return []
+        handle, self.pending = self.pending, None
+        toks, done, emitted = self.engine.harvest(handle)
         now = time.perf_counter()
         events = []
-        for slot in np.nonzero(run)[0]:
-            st = self.slots[int(slot)]
-            tok = int(nxt[slot])
-            st.req.tokens.append(tok)
-            if st.req.first_token_at is None:
-                st.req.first_token_at = now
-            st.req.last_token_at = now
-            self.tokens_generated += 1
-            self.lengths[slot] += 1
-            self.last_tok[slot] = tok
-            finished = bool(done[slot])
-            if finished:
-                st.req.finished_at = now
-                self.completed += 1
-                self.allocator.free(st.blocks)
-                self._clear(int(slot))
-            events.append(TickEvent(st.req, tok, finished))
+        for wave in range(toks.shape[0]):
+            for slot in np.nonzero(emitted[wave])[0]:
+                st = self.slots[int(slot)]
+                tok = int(toks[wave, slot])
+                st.req.tokens.append(tok)
+                if st.req.first_token_at is None:
+                    st.req.first_token_at = now
+                st.req.last_token_at = now
+                self.tokens_generated += 1
+                self.lengths[slot] += 1
+                self.last_tok[slot] = tok
+                finished = bool(done[wave, slot])
+                if finished:
+                    st.req.finished_at = now
+                    self.completed += 1
+                    self.allocator.free(st.blocks)
+                    self._clear(int(slot))
+                events.append(TickEvent(st.req, tok, finished))
         return events
 
     def _clear(self, slot: int) -> None:

@@ -209,6 +209,69 @@ def _decode_legal(config, shape, spec, dtype) -> list:
     return problems
 
 
+def _paged_legal(config, shape, spec, dtype) -> list:
+    """paged_decode: for the fused kernel, ``block_kv`` must tile the
+    pool page (sublane multiple dividing block_len, which itself must
+    be sublane-tileable for the dtype) and the per-step streamed blocks
+    must fit VMEM. For ``impl="xla"`` block_kv is INERT (the gather
+    path never reads it) — it is pinned to the default so the cross
+    product enumerates ONE xla candidate instead of timing
+    byte-identical programs once per block_kv value."""
+    from rocket_tpu.ops.paged_attention import _default_block_kv
+
+    bl, d = shape["bl"], shape["d"]
+    block_kv = config["block_kv"]
+    problems = []
+    if d % 8:
+        problems.append(f"head_dim={d} % 8 (lane-minor tiling)")
+    if config["impl"] == "xla":
+        default_kv = _default_block_kv(bl)
+        if block_kv != default_kv:
+            problems.append(
+                f"block_kv={block_kv} is inert for impl=xla — only the "
+                f"default {default_kv} is enumerated"
+            )
+        return problems
+    if bl % sublane_min(dtype):
+        # The pool page itself cannot tile for this dtype: the kernel
+        # never engages (paged_attention falls back to the gather
+        # path), so a "pallas" entry here would record a config that
+        # cannot run — reject every pallas candidate.
+        problems.append(
+            f"block_len={bl} % {sublane_min(dtype)} sublane tile "
+            f"({dtype}) — the fused kernel cannot tile this pool page"
+        )
+    if block_kv % sublane_min(dtype):
+        problems.append(
+            f"block_kv={block_kv} % {sublane_min(dtype)} sublane tile "
+            f"({dtype})"
+        )
+    if bl % block_kv:
+        problems.append(f"block_kv={block_kv} does not divide "
+                        f"block_len={bl}")
+    if spec is not None:
+        # Double-buffered K+V tiles + the q/out/accumulator residents.
+        itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+        g = max(1, shape["hq"] // max(shape["hkv"], 1))
+        need = 2 * 2 * block_kv * d * itemsize + 2 * g * d * itemsize \
+            + g * (d + 256) * 4
+        if need > spec.vmem_bytes:
+            problems.append(
+                f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+def _paged_default(shape) -> dict:
+    """An untuned checkout's behavior: the fused kernel (TPU decode
+    waves; CPU dispatch falls back to the XLA path regardless) with one
+    page — or its largest power-of-two divisor — streamed per step."""
+    from rocket_tpu.ops.paged_attention import _default_block_kv
+
+    return {"impl": "pallas", "block_kv": _default_block_kv(shape["bl"])}
+
+
 def _gmm_legal(config, shape, spec, dtype) -> list:
     problems = []
     itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
@@ -270,12 +333,16 @@ TUNE_SPACES: dict[str, TuneSpace] = {
         ),
         TuneSpace(
             kernel="paged_decode",
-            axes={"variant": ("gather",)},
-            shape_keys=("bl", "d", "hkv"),
-            default=lambda shape: {"variant": "gather"},
-            doc="paged-pool attention (ops/paged_attention.py): XLA "
-                "gather path today; the axis gains candidates when the "
-                "VMEM-streaming pallas kernel lands (ROADMAP serve note)",
+            axes={"impl": ("pallas", "xla"),
+                  "block_kv": (8, 16, 32, 64, 128)},
+            shape_keys=("s", "mb", "bl", "hkv", "hq", "d"),
+            default=_paged_default,
+            legal=_paged_legal,
+            doc="paged-pool decode attention (ops/paged_attention.py): "
+                "impl is a structural axis (fused VMEM-streaming pallas "
+                "kernel vs the XLA gather path — the tuner measures "
+                "both and may pin XLA on shapes where the gather wins), "
+                "block_kv the per-grid-step streamed KV tile height",
         ),
         TuneSpace(
             kernel="moe_gmm",

@@ -426,6 +426,57 @@ def _decode_case(name, b, hq, h_kv, d, t, dtype, smoke=False):
                     dtype=canonical_dtype(dtype), build=build, smoke=smoke)
 
 
+def _paged_case(name, s, mb, bl, hkv, hq, d, dtype, smoke=False):
+    """paged_decode at a serve-engine wave shape: operands mirror one
+    C=1 decode wave (every slot active mid-context) against a pool sized
+    exactly like ``ServeConfig.resolve`` would size it. ``impl`` is the
+    structural axis: candidates run BOTH the fused pallas kernel and the
+    XLA gather path, parity-checked against the default."""
+    import jax.numpy as jnp
+
+    shape = {"s": s, "mb": mb, "bl": bl, "hkv": hkv, "hq": hq, "d": d}
+
+    def build():
+        from rocket_tpu.ops.paged_attention import paged_attention
+
+        key = jax.random.key(5)
+        kq, kn, kp = jax.random.split(key, 3)
+        nb = 1 + s * mb
+        q = (jax.random.normal(kq, (s, 1, hq, d)) * 0.2).astype(dtype)
+        k_new = (jax.random.normal(kn, (s, 1, hkv, d)) * 0.2).astype(dtype)
+        v_new = k_new * 0.5
+        k_pages = (jax.random.normal(kp, (nb, bl, hkv, d)) * 0.2) \
+            .astype(dtype)
+        v_pages = k_pages * 0.5
+        table = jnp.asarray(
+            1 + np.arange(s * mb, dtype=np.int32).reshape(s, mb)
+        )
+        # Mid-context positions exercise both the active-page stream and
+        # the masked tail (different per slot so tiles partially fill).
+        positions = jnp.asarray(
+            [(mb * bl) // 2 + i * (bl // 2) for i in range(s)], jnp.int32
+        )
+        valid = jnp.ones((s,), jnp.int32)
+        interpret = jax.devices()[0].platform == "cpu"
+
+        @functools.lru_cache(maxsize=None)
+        def compiled(impl, block_kv):
+            return jax.jit(lambda *a: paged_attention(
+                *a, impl=impl, block_kv=block_kv, interpret=interpret,
+            ))
+
+        def run(config):
+            cfg = config or {}
+            return compiled(cfg.get("impl"), cfg.get("block_kv"))(
+                q, k_new, v_new, k_pages, v_pages, table, positions, valid
+            )
+
+        return run
+
+    return TuneCase(name=name, kernel="paged_decode", shape=shape,
+                    dtype=canonical_dtype(dtype), build=build, smoke=smoke)
+
+
 def _gmm_case(name, m, k, n, e, dtype):
     import jax.numpy as jnp
 
@@ -512,6 +563,14 @@ def _builtin_cases() -> list:
                         h_kv=4, dtype=bf16),
         _decode_case("decode/gpt2", b=8, hq=12, h_kv=12, d=64, t=512,
                      dtype=bf16),
+        # The serve-engine decode-wave shapes (ISSUE 11): charlm mirrors
+        # bench serve_summary / the serve_audit charlm target, gpt2_geom
+        # the GQA+wide-vocab audit target — the shapes whose measured
+        # ITL the fused kernel exists to fix.
+        _paged_case("paged/charlm", s=8, mb=16, bl=16, hkv=4, hq=4, d=64,
+                    dtype=bf16),
+        _paged_case("paged/gpt2_geom", s=8, mb=16, bl=32, hkv=4, hq=12,
+                    d=64, dtype=bf16),
         _gmm_case("gmm/moe_bench", m=16384, k=768, n=3072, e=4,
                   dtype=bf16),
         _gmm_case("gmm/moe_bench_out", m=16384, k=3072, n=768, e=4,
@@ -524,6 +583,8 @@ def _builtin_cases() -> list:
                         h_kv=2, dtype=bf16, smoke=True),
         _decode_case("decode/smoke", b=2, hq=2, h_kv=2, d=64, t=128,
                      dtype=bf16, smoke=True),
+        _paged_case("paged/smoke", s=2, mb=2, bl=16, hkv=2, hq=2, d=16,
+                    dtype=jnp.float32, smoke=True),
         _bn_case("bn/smoke", b=8, hw=8, c=16, dtype=bf16, smoke=True),
     ]
 

@@ -81,12 +81,15 @@ echo "== resilience smoke (supervised restart after injected kill + SIGTERM drai
 # fresh supervised launch resumes from it).
 JAX_PLATFORMS=cpu python scripts/resilience_smoke.py
 
-echo "== serve smoke (continuous batching + paged KV + compiled-once) =="
+echo "== serve smoke (continuous batching + paged KV + compiled-once + k-wave scan) =="
 # A 50-request synthetic workload through rocket_tpu.serve plus the
 # python -m rocket_tpu.serve CLI: every request must complete, the decode
 # wave / prefill chunk must each compile exactly ONCE (zero retraces
 # across admissions/evictions — checked against the obs gauges in
-# telemetry.json), and greedy outputs must match generate().
+# telemetry.json), and greedy outputs must match generate(). The scanned
+# leg re-serves an identical workload with decode_waves_per_dispatch=4:
+# greedy outputs bit-identical to k=1, zero retraces, and exactly one
+# jax.device_get per dispatch of k waves (the tunnel amortization).
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 echo "== tier-1 tests =="

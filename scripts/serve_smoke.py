@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Serve smoke gate: the continuous-batching engine end to end on CPU.
 
-Two legs (wired into scripts/check.sh and CI):
+Three legs (wired into scripts/check.sh and CI):
 
 1. **In-process**: a 50-request synthetic workload on a tiny LM through
    :class:`rocket_tpu.serve.ServeEngine` must (a) complete every request,
@@ -10,9 +10,15 @@ Two legs (wired into scripts/check.sh and CI):
    obs registry gauges, (c) produce greedy outputs token-identical to
    ``generate()`` for sampled spot-checks, and (d) leave a telemetry.json
    whose serve gauges + per-request spans tell the same story.
-2. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess must stream
-   output, print the serve report, exit 0, and the ``report`` subcommand
-   must render its telemetry.
+2. **Scanned waves** (ISSUE 11): the same model served with
+   ``decode_waves_per_dispatch=4`` must produce greedy outputs
+   BIT-IDENTICAL to the k=1 engine for an identical workload, with zero
+   retraces, exactly ONE ``jax.device_get`` per dispatch of k waves
+   (the tunnel amortization the k-wave ``lax.scan`` exists for), and a
+   measured tokens-per-dispatch meaningfully above 1.
+3. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess (with a
+   k-wave flag) must stream output, print the serve report, exit 0, and
+   the ``report`` subcommand must render its telemetry.
 
 Exits non-zero on the first violated invariant.
 """
@@ -125,12 +131,74 @@ def engine_leg(out_dir: str) -> None:
           f"tok/s={report['tokens_per_sec']:.0f})")
 
 
+def scan_leg() -> None:
+    """k-wave scanned dispatch: greedy parity with k=1, one device_get
+    per k waves, zero retraces."""
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    from rocket_tpu.serve import ServeConfig, ServeEngine
+
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(0))
+
+    def run(k):
+        engine = ServeEngine(
+            model, variables["params"],
+            ServeConfig(max_slots=4, block_len=8, prefill_chunk=8,
+                        max_model_len=48, decode_waves_per_dispatch=k),
+        )
+        rng = np.random.default_rng(7)
+        rids = []
+        for _ in range(20):
+            plen = int(rng.integers(1, 12))
+            maxnew = int(rng.integers(3, 14))
+            prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+            rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                      temperature=0.0))
+        engine.drain()
+        return engine, rids
+
+    base, base_rids = run(1)
+    scan, scan_rids = run(4)
+    for b_rid, s_rid in zip(base_rids, scan_rids):
+        b = base.result(b_rid).tokens
+        s = scan.result(s_rid).tokens
+        check(b == s, f"k=4 diverged from k=1 on request {s_rid}: {s} != {b}")
+
+    report = scan.report()
+    check(report["requests"]["completed"] == 20, "scan leg completion")
+    check(report["compiled"]["decode_traces"] == 1,
+          f"scan leg retraced: {report['compiled']}")
+    eng = scan.engine
+    check(eng.device_gets == eng.decode_dispatches,
+          f"device_gets {eng.device_gets} != dispatches "
+          f"{eng.decode_dispatches} — more than one host sync per k-wave "
+          "dispatch")
+    check(eng.decode_waves == 4 * eng.decode_dispatches,
+          f"waves {eng.decode_waves} != 4 * dispatches "
+          f"{eng.decode_dispatches}")
+    tpd = report["dispatch"]["tokens_per_dispatch"]
+    check(tpd and tpd > 1.5,
+          f"tokens_per_dispatch {tpd} — the scan is not amortizing the "
+          "tunnel")
+    # Identical greedy workload => identical token count, ~4x fewer syncs.
+    check(base.engine.device_gets > 2 * eng.device_gets,
+          f"k=4 device_gets {eng.device_gets} not materially below k=1's "
+          f"{base.engine.device_gets}")
+    print(f"serve smoke: scan leg OK (tokens/dispatch={tpd}, "
+          f"device_gets {base.engine.device_gets} -> {eng.device_gets})")
+
+
 def cli_leg(out_dir: str) -> None:
     env = dict(os.environ)
     proc = subprocess.run(
         [sys.executable, "-m", "rocket_tpu.serve", "--requests", "12",
          "--max-new-tokens", "8", "--max-slots", "4", "--block-len", "8",
-         "--prefill-chunk", "8", "--show", "1", "--out-dir", out_dir],
+         "--prefill-chunk", "8", "--waves-per-dispatch", "2",
+         "--show", "1", "--out-dir", out_dir],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
     )
     check(proc.returncode == 0,
@@ -159,6 +227,7 @@ def main() -> None:
 
     workdir = tempfile.mkdtemp(prefix="serve_smoke_", dir=repo_runs)
     engine_leg(os.path.join(workdir, "engine"))
+    scan_leg()
     cli_leg(os.path.join(workdir, "cli"))
     print("serve smoke: all checks passed")
 

@@ -377,6 +377,201 @@ def test_gqa_rope_model_serves(llama_lm):
 
 
 # ---------------------------------------------------------------------------
+# k-wave scanned dispatch (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_scanned_waves_bit_identical_greedy_and_one_sync_per_dispatch(tiny_lm):
+    """The k-wave scan must change HOW tokens are produced (one dispatch
+    + one device_get per k waves), never WHAT is produced: greedy
+    outputs bit-identical to the k=1 engine across a mixed workload,
+    with the decode program still compiled exactly once."""
+    model, variables = tiny_lm
+
+    def run(k):
+        engine = ServeEngine(
+            model, variables["params"],
+            ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                        max_model_len=48, decode_waves_per_dispatch=k),
+        )
+        rng = np.random.default_rng(23)
+        rids = []
+        for _ in range(16):
+            plen = int(rng.integers(1, 12))
+            maxnew = int(rng.integers(1, 11))
+            prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+            rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                      temperature=0.0))
+        engine.drain()
+        return engine, rids
+
+    base, base_rids = run(1)
+    for k in (3, 4):
+        scan, scan_rids = run(k)
+        for b, s in zip(base_rids, scan_rids):
+            assert scan.result(s).tokens == base.result(b).tokens, \
+                f"k={k} diverged on request {s}"
+        eng = scan.engine
+        assert eng.decode_traces == 1
+        assert eng.prefill_traces == 1
+        # One host sync per dispatch of k waves — the amortization.
+        assert eng.device_gets == eng.decode_dispatches
+        assert eng.decode_waves == k * eng.decode_dispatches
+        assert eng.device_gets < base.engine.device_gets
+    report = scan.report()
+    assert report["dispatch"]["waves_per_dispatch"] == 4
+    assert report["dispatch"]["device_get_count"] == \
+        report["dispatch"]["decode_dispatches"]
+    assert report["dispatch"]["tokens_per_dispatch"] > 1.0
+
+
+def test_scan_eos_freezes_across_dispatch_boundary(tiny_lm):
+    """A request whose EOS lands mid-scan must emit exactly up to the
+    EOS — no trailing tokens from the dispatch's remaining waves — and
+    one whose EOS falls ON a dispatch boundary must freeze into the
+    next dispatch. Both must match the k=1 engine exactly."""
+    model, variables = tiny_lm
+    prompt = np.asarray([5, 9, 2, 7], np.int32)
+    ref = _greedy_reference(model, variables, prompt, 9)
+    for eos_at in (1, 2, 3, 4):  # mid-scan and on-boundary for k=3
+        eos = int(ref[eos_at])
+        first = int(np.nonzero(ref == eos)[0][0])
+        engine = ServeEngine(
+            model, variables["params"],
+            ServeConfig(max_slots=2, block_len=4, prefill_chunk=4,
+                        max_model_len=32, decode_waves_per_dispatch=3),
+        )
+        rid = engine.submit(prompt, max_new_tokens=9, temperature=0.0,
+                            eos_token_id=eos)
+        engine.drain()
+        got = engine.result(rid).tokens
+        assert got == [int(t) for t in ref[:first + 1]], \
+            f"eos_at={eos_at}: {got} vs {ref[:first + 1]}"
+        assert engine.scheduler.active_slots == 0
+        assert engine.scheduler.allocator.free_fraction == 1.0
+
+
+def test_scanned_eviction_backpressure_resume_equivalence(tiny_lm):
+    """Eviction-resume under a starved pool with the k-wave scan: every
+    request still finishes with outputs identical to the uncontended
+    reference, with zero retraces — preemption happens strictly between
+    dispatches (harvest-before-evict), so no in-flight token is lost."""
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=32, num_blocks=9,
+                    decode_waves_per_dispatch=3),
+    )
+    rng = np.random.default_rng(3)
+    rids, prompts, maxnews = [], [], []
+    for _ in range(8):
+        plen = int(rng.integers(4, 12))
+        maxnew = int(rng.integers(8, 16))
+        prompt = rng.integers(0, 64, size=plen).astype(np.int32)
+        prompts.append(prompt)
+        maxnews.append(maxnew)
+        rids.append(engine.submit(prompt, max_new_tokens=maxnew,
+                                  temperature=0.0))
+    engine.drain()
+    report = engine.report()
+    assert report["requests"]["completed"] == 8
+    assert report["requests"]["preemptions"] > 0
+    assert report["compiled"]["decode_traces"] == 1
+    for rid, prompt, maxnew in zip(rids, prompts, maxnews):
+        ref = _greedy_reference(model, variables, prompt, maxnew)
+        np.testing.assert_array_equal(
+            np.asarray(engine.result(rid).tokens, np.int32), ref,
+            err_msg=f"request {rid} diverged across scanned preemption",
+        )
+    assert engine.scheduler.allocator.free_fraction == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The pallas paged-decode kernel (ISSUE 11 tentpole)
+# ---------------------------------------------------------------------------
+
+def _paged_operands(s=3, hq=4, hkv=2, d=16, bl=16, mb=4, dtype=np.float32):
+    rng = np.random.default_rng(11)
+    nb = 1 + s * mb
+    q = jnp.asarray(rng.normal(size=(s, 1, hq, d)).astype(np.float32)) \
+        .astype(dtype)
+    k_new = jnp.asarray(
+        rng.normal(size=(s, 1, hkv, d)).astype(np.float32)
+    ).astype(dtype)
+    v_new = k_new * 0.5
+    k_pages = jnp.asarray(
+        rng.normal(size=(nb, bl, hkv, d)).astype(np.float32)
+    ).astype(dtype)
+    v_pages = k_pages * 0.25
+    table = jnp.asarray(
+        1 + np.arange(s * mb, dtype=np.int32).reshape(s, mb)
+    )
+    # Positions spanning page-start, mid-page and the full context.
+    positions = jnp.asarray([0, bl + 3, mb * bl - 1], jnp.int32)[:s]
+    valid = jnp.ones((s,), jnp.int32)
+    return q, k_new, v_new, k_pages, v_pages, table, positions, valid
+
+
+def test_paged_decode_pallas_matches_xla_on_cpu_interpret():
+    """Fused-kernel vs XLA-gather parity on CPU-interpretable shapes:
+    outputs allclose at every legal block_kv and the scattered pool
+    bitwise identical (the scatter is shared)."""
+    from rocket_tpu.ops.paged_attention import paged_attention
+
+    ops = _paged_operands()
+    ref, kx, vx = paged_attention(*ops, impl="xla")
+    for block_kv in (8, 16):
+        out, kp, vp = paged_attention(
+            *ops, impl="pallas", block_kv=block_kv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5,
+            err_msg=f"block_kv={block_kv}",
+        )
+        np.testing.assert_array_equal(np.asarray(kp), np.asarray(kx))
+        np.testing.assert_array_equal(np.asarray(vp), np.asarray(vx))
+    with pytest.raises(ValueError, match="block_kv"):
+        paged_attention(*ops, impl="pallas", block_kv=12, interpret=True)
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(*ops, impl="mosaic")
+
+
+def test_paged_decode_cpu_default_is_xla_bitwise():
+    """The CPU fallback: with no explicit impl (and no table entry) the
+    dispatch must route to the XLA path and be BITWISE identical to it
+    — an untuned CPU checkout behaves exactly like the pre-kernel code."""
+    from rocket_tpu.ops.paged_attention import paged_attention
+
+    ops = _paged_operands()
+    ref, kx, vx = paged_attention(*ops, impl="xla")
+    out, kp, vp = paged_attention(*ops)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(kp), np.asarray(kx))
+    # Unsupported page geometry (block_len % sublane != 0) must also
+    # fall back rather than die, even when pallas is pinned.
+    small = _paged_operands(bl=4, mb=2)
+    a, _, _ = paged_attention(*small, impl="pallas", interpret=True)
+    b, _, _ = paged_attention(*small, impl="xla")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_paged_decode_supported_gate():
+    from rocket_tpu.ops.paged_attention import (
+        _default_block_kv,
+        paged_decode_supported,
+    )
+
+    assert paged_decode_supported(16, 64, 4)        # f32, one sublane tile
+    assert paged_decode_supported(16, 64, 2)        # bf16 at 16 rows
+    assert not paged_decode_supported(8, 64, 2)     # bf16 needs 16 rows
+    assert not paged_decode_supported(4, 64, 4)     # sub-sublane page
+    assert not paged_decode_supported(16, 12, 4)    # D % 8
+    assert _default_block_kv(16) == 16
+    assert _default_block_kv(256) == 128
+    assert _default_block_kv(32, itemsize=2) == 32
+
+
+# ---------------------------------------------------------------------------
 # The shared sampling core / generate() satellite
 # ---------------------------------------------------------------------------
 

@@ -128,6 +128,28 @@ def test_decode_floor_bytes_exact():
     ) == expected
 
 
+def test_fused_decode_bytes_is_floor_plus_logits_traffic():
+    """The fused-kernel byte model (ISSUE 11): the active-pages-only
+    gather floor plus the (S, V) f32 logits written once and re-read by
+    the runtime-knob sampling core — nothing pool-sized beyond the
+    mapped pages."""
+    from rocket_tpu.analysis.serve_audit import fused_decode_bytes
+
+    spec = KVPoolSpec(num_layers=2, num_blocks=9, block_len=4,
+                      num_kv_heads=3, head_dim=5, dtype="float32")
+    floor = decode_floor_bytes(spec, 1000, max_slots=7,
+                               max_blocks_per_seq=2)
+    fused = fused_decode_bytes(spec, 1000, max_slots=7,
+                               max_blocks_per_seq=2, vocab_size=50)
+    assert fused == floor + 4 * 7 * 50 * 4
+    # The model is independent of num_blocks: the kernel streams mapped
+    # pages, not the pool — a 100x pool prices identically.
+    big = KVPoolSpec(num_layers=2, num_blocks=900, block_len=4,
+                     num_kv_heads=3, head_dim=5, dtype="float32")
+    assert fused == fused_decode_bytes(big, 1000, max_slots=7,
+                                       max_blocks_per_seq=2, vocab_size=50)
+
+
 # -- RKT603: HBM fit ---------------------------------------------------------
 
 class _Dev:
@@ -370,6 +392,80 @@ def test_committed_budgets_match_the_builtin_targets():
     assert names == expected
     for name in names:
         assert load_budget(budget_dir, name) is not None
+
+
+@pytest.fixture(scope="module")
+def charlm_report():
+    from rocket_tpu.analysis.serve_audit import SERVE_TARGETS, run_serve_target
+
+    return run_serve_target(SERVE_TARGETS["charlm"])
+
+
+def test_kwave_target_audits_clean_with_scan_pricing(charlm_report):
+    """The charlm target scans k=4 waves per dispatch: the audit
+    compiles the REAL scanned program (plus a single-wave attribution
+    compile), prices per-TOKEN ITL under the fused-kernel byte model,
+    and decomposes TTFT with the k-wave observation delay."""
+    report = charlm_report
+    assert report.findings == [], [f.render() for f in report.findings]
+    names = {p.name for p in report.programs}
+    assert names == {"decode", "decode_wave", "prefill"}
+    record = report.record
+    assert record["waves_per_dispatch"] == 4
+    assert record["byte_model"] == "fused-paged"
+    # Per-token ITL prices the FUSED bytes, far under the XLA gather's.
+    assert record["decode_traffic_bytes"] == record["fused_decode_bytes"]
+    assert record["decode_traffic_bytes"] < record["xla_traffic_bytes"]
+    assert record["predicted_itl_us"] < record["xla_traffic_bytes"] / \
+        record["decode_traffic_bytes"] * record["itl_floor_us"] * 2
+    # TTFT = chunk schedule + k waves (first token observed when the
+    # whole first dispatch returns): ceil(63/32) = 2 chunks, k = 4.
+    assert record["predicted_ttft_us"] == pytest.approx(
+        2 * record["prefill_chunk_us"] + 4 * record["predicted_itl_us"],
+        rel=1e-6,
+    )
+    # The overfetch ratio still audits the compiled XLA fallback path.
+    assert record["overfetch_ratio"] == pytest.approx(
+        record["xla_traffic_bytes"] / record["decode_floor_bytes"],
+        rel=0.01,
+    )
+
+
+def test_kwave_lattice_drives_scanned_recording_engine(charlm_report):
+    """The lattice proof is non-vacuous at k=4: every required state
+    observed through the pipelined scheduler, one signature, and the
+    recording engine simulated k waves per recorded dispatch."""
+    lattice = charlm_report.record["lattice"]
+    assert set(REQUIRED_LATTICE_STATES) <= set(lattice["states"])
+    assert lattice["decode_signatures"] == 1
+
+
+def test_recording_engine_scan_freezes_mid_dispatch():
+    """The recording engine's k-wave simulation matches the compiled
+    scan's carry semantics: a slot hitting its limit mid-dispatch stops
+    emitting in later waves of the same dispatch."""
+    engine = _tiny_engine()
+    engine.waves_per_dispatch = 4
+    block_table = np.zeros((4, 8), np.int32)
+    lengths = np.asarray([0, 0, 0, 0], np.int32)
+    last = np.asarray([1, 2, 3, 4], np.int32)
+    run = np.asarray([True, True, False, False])
+    limits = np.asarray([2, 10, 0, 0], np.int32)  # slot 0 done after 2
+    z_i = np.zeros((4,), np.int32)
+    z_f = np.zeros((4,), np.float32)
+    toks, done, emitted = engine.decode(
+        block_table, lengths, last, run, limits, z_f, z_i,
+        np.ones((4,), np.float32), np.full((4,), -1, np.int32), z_i,
+    )
+    assert toks.shape == (4, 4)
+    # Slot 0 emits waves 0-1 then freezes; slot 1 emits all 4 waves.
+    np.testing.assert_array_equal(emitted[:, 0], [True, True, False, False])
+    np.testing.assert_array_equal(emitted[:, 1], [True] * 4)
+    np.testing.assert_array_equal(done[:, 0], [False, True, False, False])
+    # Inactive slots never emit.
+    assert not emitted[:, 2].any() and not emitted[:, 3].any()
+    assert engine.device_gets == 1 and engine.decode_dispatches == 1
+    assert engine.decode_waves == 4
 
 
 # -- calibration vs the measured serve record --------------------------------

@@ -526,6 +526,103 @@ def test_spaces_reject_vmem_overflow_and_enumerate_legal():
     )  # not a candidate value
 
 
+PAGED_SHAPE = {"s": 8, "mb": 16, "bl": 16, "hkv": 4, "hq": 4, "d": 64}
+
+
+def test_paged_decode_space_axes_and_legality():
+    """The paged_decode TuneSpace carries REAL axes (the `variant`
+    placeholder is retired): a structural impl axis and the streamed
+    block_kv tile, with sublane/divisibility legality."""
+    from rocket_tpu.utils.perf import device_spec
+
+    space = TUNE_SPACES["paged_decode"]
+    assert set(space.axes) == {"impl", "block_kv"}
+    assert "variant" not in space.axes
+    assert set(space.axes["impl"]) == {"pallas", "xla"}
+    spec = device_spec("TPU v5 lite")
+    candidates = space.candidates(PAGED_SHAPE, spec, "bfloat16")
+    # bf16 sublane is 16 and bl=16: block_kv=16 is the only legal tile,
+    # once per impl.
+    assert candidates == [
+        {"block_kv": 16, "impl": "pallas"},
+        {"block_kv": 16, "impl": "xla"},
+    ]
+    f32 = space.candidates(PAGED_SHAPE, spec, "float32")
+    assert {"block_kv": 8, "impl": "pallas"} in f32
+    assert space.violations(
+        {"impl": "pallas", "block_kv": 12}, PAGED_SHAPE, spec, "float32"
+    )  # not an axis member
+    assert space.violations(
+        {"impl": "pallas", "block_kv": 32}, PAGED_SHAPE, spec, "float32"
+    )  # does not divide bl=16
+    # Default = untuned behavior: the fused kernel, one page per step.
+    assert space.default(PAGED_SHAPE) == {"impl": "pallas", "block_kv": 16}
+    assert "s" in space.shape_keys and "hq" in space.shape_keys
+
+
+def test_paged_decode_table_resolution(table_dir):
+    """A table entry must steer the live dispatch: pin impl=xla for the
+    exact serve shape and paged_attention must take the gather path on
+    a geometry the kernel supports."""
+    from rocket_tpu.ops.paged_attention import paged_attention
+
+    shape = {"s": 2, "mb": 2, "bl": 16, "hkv": 2, "hq": 2, "d": 16}
+    tune.write_table("paged_decode", [{
+        "device_kind": "TPU v5 lite", "dtype": "float32",
+        "shape": shape,
+        "shape_bucket": TUNE_SPACES["paged_decode"].bucket(shape),
+        "config": {"impl": "xla", "block_kv": 16},
+    }], configs_dir=table_dir)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 1, 2, 16)).astype(np.float32))
+    kn = jnp.asarray(rng.normal(size=(2, 1, 2, 16)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(size=(5, 16, 2, 16)).astype(np.float32))
+    table = jnp.asarray(np.asarray([[1, 2], [3, 4]], np.int32))
+    pos = jnp.asarray([3, 17], jnp.int32)
+    valid = jnp.ones((2,), jnp.int32)
+    with tune.priced_device_kind("TPU v5 lite"):
+        out_t, _, _ = paged_attention(q, kn, kn * 0.5, kp, kp * 0.25,
+                                      table, pos, valid)
+    out_x, _, _ = paged_attention(q, kn, kn * 0.5, kp, kp * 0.25,
+                                  table, pos, valid, impl="xla")
+    np.testing.assert_array_equal(np.asarray(out_t), np.asarray(out_x))
+    log = tune.lookup_log_summary()
+    hits = [r for r in log if r["kernel"] == "paged_decode"
+            and r["source"] == "table"]
+    assert hits and hits[0]["config"]["impl"] == "xla"
+
+
+def test_paged_decode_cases_mirror_serve_shapes():
+    """The sweep catalog carries the serve-engine wave shapes (charlm ==
+    bench serve_summary / serve_audit charlm; gpt2_geom the GQA target)
+    plus a CPU smoke case, and the smoke sweep is parity-clean."""
+    from rocket_tpu.tune.tuner import load_cases
+
+    cases = load_cases()
+    charlm = cases["paged/charlm"]
+    assert charlm.kernel == "paged_decode"
+    assert charlm.shape == {"s": 8, "mb": 16, "bl": 16, "hkv": 4,
+                            "hq": 4, "d": 64}
+    assert charlm.dtype == "bfloat16"
+    gpt2 = cases["paged/gpt2_geom"]
+    assert gpt2.shape["bl"] == 32 and gpt2.shape["hq"] == 12
+    assert cases["paged/smoke"].smoke
+
+
+@pytest.mark.slow
+def test_paged_decode_smoke_sweep_parity_clean(table_dir):
+    """The full CPU smoke sweep of the paged case: every candidate
+    (both impls, interpret mode) must pass parity against the default."""
+    from rocket_tpu.tune.tuner import load_cases
+
+    report = sweep_case(load_cases()["paged/smoke"], iters=1)
+    assert report.default_config["impl"] == "pallas"
+    assert report.results, "no candidates enumerated"
+    for result in report.results:
+        assert result.error is None, result.error
+        assert result.parity_ok, (result.config, result.max_err)
+
+
 def test_update_tables_merges_other_device_kinds(tmp_path):
     """Re-tuning one device kind must not drop another's rows."""
     from rocket_tpu.tune.tuner import CandidateResult, CaseReport, \
