@@ -199,19 +199,32 @@ _TRANSPARENT = frozenset({
     "reshape", "transpose", "broadcast_in_dim", "squeeze", "slice",
     "dynamic_slice", "gather", "rev", "copy", "stop_gradient", "name",
     "pad", "expand_dims",
+    # A bit-pack for the wire (bf16 -> u16 around a collective, see
+    # parallel/collectives._wire_pack) moves the SAME value — the
+    # narrowing that matters already happened at the convert before it.
+    "bitcast_convert_type",
 })
 
 #: Manual-collective primitives RKT403 watches (shard_map bodies; GSPMD
 #: collectives exist only post-compile and are the SPMD auditor's job).
 _COLLECTIVE_PRIMS = frozenset({
-    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
-    "pmax", "pmin",
+    "psum", "psum_scatter", "reduce_scatter", "all_gather", "all_to_all",
+    "ppermute", "pmax", "pmin",
 })
 
 #: eqn param names that can hold a call-like sub-jaxpr (pjit bodies,
 #: remat, custom_jvp/vjp, shard_map). When the inner invar count matches
 #: the eqn's, the mapping is positional and provenance threads through.
 _CALL_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+#: Named scopes that mark a DELIBERATE wire compression (the overlapped
+#: collectives' gradient wire and the vocab-parallel lookup —
+#: ``parallel/collectives.py`` / ``parallel/grad_sync.py``). A narrow
+#: under one of these is a compression to certify even when it lands ON
+#: the compute dtype; narrows under other scopes (e.g. jax's
+#: ``rematted_computation``) at the compute dtype are normal activation
+#: flow.
+_WIRE_SCOPES = frozenset({"ring_wire", "grad_buckets", "embed_wire"})
 
 
 def _merge_provs(a: _Prov, b: _Prov) -> _Prov:
@@ -301,7 +314,19 @@ class _Walker:
                     else src.dtype
                 if (narrowed_at is None
                         and dst_size < np.dtype(master).itemsize):
-                    narrowed_at = "convert_element_type"
+                    # Record WHERE the narrow happened: the jax
+                    # named_scope stack, when one is set, names the
+                    # deliberate wire-compression sites
+                    # (ring_wire/grad_buckets/embed_wire in
+                    # parallel/collectives + grad_sync) — the collective
+                    # facts below key certification globs on it.
+                    scope = str(
+                        getattr(eqn.source_info, "name_stack", "") or ""
+                    )
+                    narrowed_at = (
+                        f"convert_element_type@{scope}"
+                        if scope else "convert_element_type"
+                    )
                 # Churn: this narrow lands back on the dtype the value was
                 # widened FROM, with only transparent ops in between.
                 if (src.cast_from is not None
@@ -495,6 +520,35 @@ class _Walker:
             if name == "convert_element_type":
                 env[eqn.outvars[0]] = self._handle_convert(eqn, in_provs)
                 continue
+            if name == "select_n" and len(in_provs) > 1:
+                # A select merges its VALUE operands (operand 0 is the
+                # predicate): like cond branches, disagreement degrades
+                # to "compute" but a narrowing on EITHER side survives —
+                # masking (jnp.where) must not launder a narrowed value
+                # before it reaches a collective. Masking a PARAM
+                # against a plain constant keeps the param's identity
+                # (a vocab-sharded embedding gather zeroes misses; the
+                # rows are still the table).
+                values = in_provs[1:]
+                interesting = [
+                    p for p in values
+                    if p.origin in ("param", "state") or p.narrowed_at
+                ]
+                if len(interesting) == 1:
+                    merged = interesting[0]
+                else:
+                    merged = values[0]
+                    for other in values[1:]:
+                        merged = _merge_provs(merged, other)
+                env[eqn.outvars[0]] = _Prov(
+                    dtype=getattr(eqn.outvars[0].aval, "dtype", None),
+                    origin=merged.origin, path=merged.path,
+                    master_dtype=merged.master_dtype,
+                    narrowed_at=merged.narrowed_at,
+                    widened_from=merged.widened_from,
+                    cast_from=merged.cast_from,
+                )
+                continue
             if name in _TRANSPARENT and in_provs:
                 src = in_provs[0]
                 for var in eqn.outvars:
@@ -527,16 +581,55 @@ class _Walker:
                     shape=tuple(getattr(out_aval, "shape", ())),
                 ))
             elif name in _COLLECTIVE_PRIMS:
+                floor = (
+                    np.dtype(compute_dtype).itemsize
+                    if compute_dtype is not None else 4
+                )
                 for prov, var in zip(in_provs, eqn.invars):
-                    if (prov.origin == "param"
-                            and prov.narrowed_at is not None):
-                        self.flow.collectives.append(CollectiveFact(
-                            prim=name,
-                            dtype=getattr(var.aval, "dtype", None),
-                            param_path=prov.path,
-                            master_dtype=prov.master_dtype,
-                            narrowed_at=prov.narrowed_at,
-                        ))
+                    if prov.narrowed_at is None:
+                        continue
+                    if prov.origin == "param":
+                        path = prov.path
+                    else:
+                        # A non-param value narrowed below its master
+                        # dtype crossing a device boundary is a fact
+                        # when the narrow is a COMPRESSION: either its
+                        # dtype sits below the declared compute dtype,
+                        # or the narrowing convert ran under an explicit
+                        # named scope (the marker of a deliberate wire
+                        # site — ring_wire / grad_buckets). A bf16
+                        # model's incidental post-norm casts (unscoped,
+                        # at the compute dtype) are its normal
+                        # activation flow, not a compression. The
+                        # fact's path is the narrow's scope, so
+                        # certifications stay per-site, never blanket.
+                        scope = (
+                            prov.narrowed_at.split("@", 1)[1]
+                            if "@" in prov.narrowed_at else ""
+                        )
+                        dtype = getattr(prov, "dtype", None)
+                        try:
+                            below_floor = (
+                                dtype is not None
+                                and np.dtype(dtype).itemsize < floor
+                            )
+                        except TypeError:
+                            below_floor = False
+                        wire_scoped = bool(
+                            _WIRE_SCOPES.intersection(scope.split("/"))
+                        )
+                        if not below_floor and not wire_scoped:
+                            continue
+                        path = tuple(
+                            part for part in scope.split("/") if part
+                        ) or ("wire",)
+                    self.flow.collectives.append(CollectiveFact(
+                        prim=name,
+                        dtype=getattr(var.aval, "dtype", None),
+                        param_path=path,
+                        master_dtype=prov.master_dtype,
+                        narrowed_at=prov.narrowed_at,
+                    ))
 
             for var in eqn.outvars:
                 env[var] = _prov_for_aval(var.aval, origin="compute")
@@ -717,26 +810,42 @@ class PrecTarget:
     demo: bool = False
 
 
-def _bf16_train_parts(**overrides):
+def _bf16_train_parts(rules=None, mesh_shape=None, **overrides):
+    """bf16-compute step, built the way the paired SPMD target builds it
+    — including the overlapped-collective context when ``rules`` carries
+    the markers, so the precision audit walks the SAME program the
+    budgets price (and sees its certified wire narrows)."""
     from rocket_tpu.analysis.shard_audit import _lm_config, _lm_parts
 
     config = _lm_config(activation_dtype="bfloat16", **overrides)
     step_fn, variables, batch, _rules, _donate = _lm_parts(
-        None, config=config
+        rules, config=config, mesh_shape=mesh_shape
     )
     return step_fn, variables, batch, True
 
 
 def _tp_parts():
-    return _bf16_train_parts()
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+    return _bf16_train_parts(
+        gpt2_tp_rules(axis="model"), mesh_shape={"data": 2, "model": 4}
+    )
 
 
 def _scan_parts():
-    return _bf16_train_parts(scan_layers=True)
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+    return _bf16_train_parts(
+        gpt2_tp_rules(axis="model"), mesh_shape={"data": 1, "model": 8},
+        scan_layers=True,
+    )
 
 
 def _gpt2_layerset_parts():
+    from rocket_tpu.parallel.sharding import fsdp_rules
+
     return _bf16_train_parts(
+        fsdp_rules(axis="data", min_size=4096), mesh_shape={"data": 8},
         pos_embedding="learned", norm="layernorm", mlp="gelu",
         tied_embeddings=True,
     )
@@ -744,10 +853,12 @@ def _gpt2_layerset_parts():
 
 def _eval_parts():
     from rocket_tpu.analysis.shard_audit import _lm_config, _lm_parts
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
 
     config = _lm_config(activation_dtype="bfloat16")
     step_fn, variables, batch, _rules, _donate = _lm_parts(
-        None, train=False, config=config
+        gpt2_tp_rules(axis="model"), train=False, config=config,
+        mesh_shape={"data": 2, "model": 4},
     )
     return step_fn, variables, batch, False
 

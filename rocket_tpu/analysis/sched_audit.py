@@ -436,7 +436,18 @@ def cost_ops(
                 # only the final element so sync and async forms agree.
                 result_bytes = instr.shapes[-1][2]
             bytes_moved = _ring_bytes(comm_kind, result_bytes, group)
-            time_s = bytes_moved / spec.ici_bw + COLLECTIVE_LATENCY_S
+            # Bulk collectives run XLA's multi-dimensional rings and
+            # drive every ICI link at once (aggregate bandwidth); an
+            # explicit collective-permute hop moves its chunk over ONE
+            # link — priced hop-by-hop at the per-link column, which is
+            # what makes a ppermute ring honest against a bulk
+            # all-gather of the same bytes.
+            bw = (
+                spec.ici_link_bw
+                if comm_kind == "collective-permute"
+                else spec.ici_bw
+            )
+            time_s = bytes_moved / bw + COLLECTIVE_LATENCY_S
             ops.append(OpCost(
                 name=instr.name, opcode=instr.opcode, kind="comm",
                 time_s=time_s, flops=0.0, hbm_bytes=hbm_bytes,
@@ -541,7 +552,16 @@ def simulate(ops: Sequence[OpCost], *, overlap: bool) -> SimResult:
             if op.opcode.endswith("-done"):
                 finish[op.name] = dep_t
                 continue
-            sync = not op.opcode.endswith("-start")
+            # collective-permute is a point-to-point DMA on TPU — the
+            # sequencer issues the send and runs on; XLA lowers it to
+            # -start/-done pairs there. The CPU fake-mesh dump keeps the
+            # sync spelling, so the simulator restores the async
+            # semantics by opcode: a permute floats to its dependency
+            # time and only its CONSUMERS wait.
+            sync = not (
+                op.opcode.endswith("-start")
+                or op.opcode.startswith("collective-permute")
+            )
             # A sync collective is issued by the in-order sequencer: it
             # cannot start before the compute stream reaches it. Only
             # async -start ops float back to their dependency time.
@@ -1005,6 +1025,12 @@ def _tp_sched_parts():
     return _tp_parts()
 
 
+def _tp_2x4_sched_parts():
+    from rocket_tpu.analysis.shard_audit import _tp_2x4_parts
+
+    return _tp_2x4_parts()
+
+
 def _tp_eval_sched_parts():
     from rocket_tpu.analysis.shard_audit import _tp_eval_parts
 
@@ -1109,6 +1135,83 @@ def _badsched_parts():
     return bad_step, variables, batch, None, ()
 
 
+def _badoverlap_parts():
+    """Seeded-bad data-parallel step for the overlap true-positive
+    fixtures — the exact shape the overlapped paths exist to kill:
+
+    * an UNBUCKETED per-parameter gradient all-reduce convoy (one tiny
+      fp32 ``psum`` per leaf, dependency-chained so nothing can hide
+      them — RKT502, and the latency sum shows up as RKT501 exposure);
+    * a synchronous full-batch ``all_gather`` whose result is consumed
+      only at the END of the step while the first matmul — independent
+      of it — sits behind it in program order (RKT501: the dataflow
+      pass hides it entirely, the as-compiled schedule cannot).
+
+    A regression that reintroduces this shape in the real paths fails
+    the budget gates; this demo proves the RULES would also still name
+    it."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.utils.compat import shard_map
+
+    mesh = _mesh_from_shape({"data": 8})
+    from jax.sharding import PartitionSpec as P
+
+    n_leaves = 12
+    variables = {
+        "params": {
+            f"w{i}": jax.ShapeDtypeStruct((512, 512), jnp.float32)
+            for i in range(n_leaves)
+        },
+        "state": {},
+    }
+    batch = {"x": jax.ShapeDtypeStruct((2048, 512), jnp.float32)}
+
+    def body(x, *ws):
+        # Sync all-gather of the whole batch issued FIRST, consumed only
+        # at the very END — the layer chain below is independent of it,
+        # so the dataflow pass hides it entirely while the as-compiled
+        # schedule blocks on it (RKT501).
+        gathered = jax.lax.all_gather(x, "data", axis=0, tiled=True)
+        h = x
+        sums = []
+        for w in ws:
+            h = jnp.tanh(h @ w)                     # (B/8, 512)
+            s = jnp.sum(h, axis=0)                  # (512,) local "grad"
+            sums.append(s)
+            # The next layer consumes the local sum, pinning it into
+            # the compute phase (as backward-produced grads are).
+            h = h + s * 0.0
+        # Unbucketed per-param "grad" reduction: a dependency-chained
+        # convoy of tiny fp32 psums (RKT502) — the exact anti-pattern
+        # grad_sync's buckets amortize. The local sums are hoisted so
+        # the psums sit back-to-back in the schedule, as per-param grad
+        # reductions do at a real step's tail.
+        # Every "grad" reduction waits for the chain's end (the tail
+        # salt), exactly like real per-param reductions at a step's
+        # tail — so the psums sit back-to-back.
+        tail_salt = jnp.sum(h) * 0.0
+        total = jnp.zeros((512,), jnp.float32)
+        for s in sums:
+            total = total + jax.lax.psum(
+                s + tail_salt + total * 0.0, "data"
+            )
+        total = jnp.sum(total)
+        return jax.lax.psum(
+            h.sum() + gathered[-1].sum() * 1e-6 + total, "data"
+        )
+
+    def bad_step(variables, batch):
+        ws = tuple(variables["params"].values())
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P("data"),) + (P(),) * len(ws), out_specs=P(),
+        )
+        return variables, fn(batch["x"], *ws)
+
+    return bad_step, variables, batch, None, ()
+
+
 def _badpallas_parts():
     """Seeded-bad pallas_call for the RKT504 fixtures: blocks misaligned
     with the (8, 128) f32 tile and a VMEM-overflowing block, traced only
@@ -1160,28 +1263,24 @@ def _register_targets():
         SchedTarget(
             name="tp_2x4",
             mesh_shape={"data": 2, "model": 4},
-            build=_tp_sched_parts,
+            build=_tp_2x4_sched_parts,
             mfu_floor=0.007,
-            # Known headroom on the sharded train targets: ~17-20% of
-            # the step is reshard/all-reduce/all-gather time the DAG
-            # could hide (ROADMAP item 3 — overlap/async collectives).
-            # Tracked by the exposed_comm_us budget; the RKT501 gate
-            # sits above today's level so only NEW exposure fails CI.
-            overrides={"exposed_frac_min": 0.25},
+            # The overlapped collective paths (PR 12) brought the
+            # hideable exposure under the DEFAULT RKT501 gate (0.15) —
+            # no override: a regression back toward unoverlapped comm
+            # trips the rule as well as the exposed_comm_us budget.
         ),
         SchedTarget(
             name="tp_1x8",
             mesh_shape={"data": 1, "model": 8},
             build=_tp_sched_parts,
             mfu_floor=0.005,
-            overrides={"exposed_frac_min": 0.25},  # see tp_2x4
         ),
         SchedTarget(
             name="fsdp_1x8",
             mesh_shape={"data": 8},
             build=_fsdp_sched_parts,
             mfu_floor=0.012,
-            overrides={"exposed_frac_min": 0.25},  # see tp_2x4
         ),
         SchedTarget(
             name="tp_2x4_eval",
@@ -1213,6 +1312,14 @@ def _register_targets():
             mfu_floor=0.9,
             overrides={"convoy_min": 4, "bucket_bytes": 1 << 20,
                        "memory_frac_max": 0.2,
+                       "exposed_frac_min": 0.05, "exposed_min_s": 1e-6},
+            demo=True,
+        ),
+        SchedTarget(
+            name="badoverlap",
+            mesh_shape={"data": 8},
+            build=_badoverlap_parts,
+            overrides={"convoy_min": 6, "bucket_bytes": 1 << 20,
                        "exposed_frac_min": 0.05, "exposed_min_s": 1e-6},
             demo=True,
         ),

@@ -525,7 +525,17 @@ def _lm_config(**overrides):
 
 
 def _lm_parts(rules, *, train: bool = True, batch_size: int = 16,
-              config=None):
+              config=None, mesh_shape=None):
+    """Build one audit step. When ``rules`` carries the overlap markers
+    (``gpt2_tp_rules``' ``tp_axis`` / ``fsdp_rules``' ``fsdp_axis``) and
+    a ``mesh_shape`` is given, the step is built the way ``core.Module``
+    builds it in production: the forward traces under the
+    ``tp_overlap`` context (ring/bulk collective matmuls, sequence-
+    sharded residual stream) and the FSDP gradient reduction runs
+    through the bucketed async reduce-scatter (``parallel.grad_sync``)
+    — so the committed budgets price the overlapped program.
+    ``ROCKET_TPU_OVERLAP=0`` at build time restores the plain GSPMD
+    step (the bench off-leg and the fallback-identity tests use it)."""
     from rocket_tpu.models.transformer import TransformerLM
 
     model = TransformerLM(config if config is not None else _lm_config())
@@ -536,30 +546,97 @@ def _lm_parts(rules, *, train: bool = True, batch_size: int = 16,
         )
     }
 
+    from rocket_tpu.parallel.collectives import overlap_enabled, tp_overlap
+
+    tp_axis = getattr(rules, "tp_axis", None)
+    fsdp_axis = getattr(rules, "fsdp_axis", None)
+    mesh = None
+    if mesh_shape is not None and (tp_axis or fsdp_axis) \
+            and overlap_enabled():
+        mesh = _mesh_from_shape(mesh_shape)
+
+    def apply_model(variables, batch, mode):
+        if mesh is not None and tp_axis:
+            with tp_overlap(
+                mesh, axis=tp_axis, data_axes=("data",),
+                vocab_sharded_embed=bool(
+                    getattr(rules, "tp_vocab_sharded", False)
+                ),
+            ):
+                return model.apply(variables, dict(batch), mode=mode)
+        return model.apply(variables, dict(batch), mode=mode)
+
     if not train:
         def eval_step(variables, batch):
-            out, _state = model.apply(variables, dict(batch), mode="eval")
+            out, _state = apply_model(variables, batch, "eval")
             return out["logits"]
 
+        if mesh is not None and tp_axis \
+                and model.config.activation_dtype is not None:
+            # The vocab-parallel lookup narrows the fp32 master table
+            # onto the wire in the FORWARD — certify it on the eval
+            # step too.
+            from rocket_tpu.analysis.prec_audit import certify_collectives
+
+            eval_step = certify_collectives("params/wte/table")(eval_step)
         return eval_step, variables, batch, rules, ()
 
     import optax
 
     def loss_fn(variables, batch):
-        out, _state = model.apply(variables, dict(batch), mode="train")
+        out, _state = apply_model(variables, batch, "train")
         logits = out["logits"][:, :-1].astype(jnp.float32)
         targets = out["tokens"][:, 1:]
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, targets
         ).mean()
 
-    def train_step(variables, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(variables, batch)
-        params = jax.tree.map(
+    def _sgd(variables, grads):
+        return jax.tree.map(
             lambda p, g: (p - 1e-3 * g).astype(p.dtype),
             variables["params"], grads["params"],
         )
+
+    if mesh is not None and fsdp_axis:
+        from rocket_tpu.analysis.prec_audit import certify_collectives
+        from rocket_tpu.parallel import grad_sync
+
+        def spec_fn(path, leaf):
+            if path and path[0] == "params":
+                return rules(path[1:], leaf)
+            return None
+
+        @certify_collectives("*grad_buckets*")
+        def train_step(variables, batch):
+            (loss, _aux), grads = grad_sync.value_and_grad_sharded(
+                loss_fn, variables, batch,
+                mesh=mesh, data_axes=("data",), spec_fn=spec_fn,
+                bucket_bytes=1 << 20, wire_dtype="bfloat16",
+            )
+            params = _sgd(variables, grads)
+            return {"params": params, "state": variables["state"]}, loss
+
+        return train_step, variables, batch, rules, (0,)
+
+    def train_step(variables, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(variables, batch)
+        params = _sgd(variables, grads)
         return {"params": params, "state": variables["state"]}, loss
+
+    if mesh is not None and tp_axis:
+        from rocket_tpu.analysis.prec_audit import certify_collectives
+
+        # Certify exactly the compressions the wiring creates for THIS
+        # config: an fp32-compute model narrows gradients onto the wire
+        # in the backward rings (facts carry the ring_wire scope); a
+        # bf16-compute model's rings already run at the compute dtype,
+        # but the vocab-parallel lookup narrows the fp32 MASTER table
+        # into its reduce-scatter (a param-path fact).
+        if model.config.activation_dtype is None:
+            certs = ("*ring_wire*",)
+        else:
+            certs = ("params/wte/table",)
+        train_step = certify_collectives(*certs)(train_step)
 
     return train_step, variables, batch, rules, (0,)
 
@@ -567,19 +644,34 @@ def _lm_parts(rules, *, train: bool = True, batch_size: int = 16,
 def _tp_parts():
     from rocket_tpu.parallel.sharding import gpt2_tp_rules
 
-    return _lm_parts(gpt2_tp_rules(axis="model"))
+    return _lm_parts(
+        gpt2_tp_rules(axis="model"), mesh_shape={"data": 1, "model": 8}
+    )
+
+
+def _tp_2x4_parts():
+    from rocket_tpu.parallel.sharding import gpt2_tp_rules
+
+    return _lm_parts(
+        gpt2_tp_rules(axis="model"), mesh_shape={"data": 2, "model": 4}
+    )
 
 
 def _tp_eval_parts():
     from rocket_tpu.parallel.sharding import gpt2_tp_rules
 
-    return _lm_parts(gpt2_tp_rules(axis="model"), train=False)
+    return _lm_parts(
+        gpt2_tp_rules(axis="model"), train=False,
+        mesh_shape={"data": 2, "model": 4},
+    )
 
 
 def _fsdp_parts():
     from rocket_tpu.parallel.sharding import fsdp_rules
 
-    return _lm_parts(fsdp_rules(axis="data", min_size=4096))
+    return _lm_parts(
+        fsdp_rules(axis="data", min_size=4096), mesh_shape={"data": 8}
+    )
 
 
 def _badrules_parts():
@@ -606,34 +698,45 @@ def _badrules_parts():
 BUILTIN_TARGETS: dict[str, AuditTarget] = {
     target.name: target
     for target in (
+        # Allowlists are measured counts on the OVERLAPPED program with
+        # headroom (a new XLA may legally shift a few ops; a wiring
+        # regression — e.g. the rings collapsing back to per-layer
+        # all-reduces — blows straight through). The permute budget
+        # covers the tiny per-layer QKV weight-slice reshards plus ring
+        # hops when a target forces ring mode.
         AuditTarget(
             name="tp_2x4",
             mesh_shape={"data": 2, "model": 4},
-            build=_tp_parts,
-            allow={"all-gather": 12, "reduce-scatter": 8,
-                   "all-to-all": 0, "collective-permute": 24},
+            build=_tp_2x4_parts,
+            allow={"all-gather": 28, "reduce-scatter": 14,
+                   "all-to-all": 14, "collective-permute": 80,
+                   # Includes the per-layer weight-grad psums over the
+                   # data axis (dw is computed per batch shard inside
+                   # the manual region; bucketing them needs the
+                   # mixed-mesh grad_sync — ROADMAP item 2c).
+                   "all-reduce": 52},
         ),
         AuditTarget(
             name="tp_1x8",
             mesh_shape={"data": 1, "model": 8},
             build=_tp_parts,
-            allow={"all-gather": 12, "reduce-scatter": 8,
-                   "all-to-all": 0, "collective-permute": 48},
+            allow={"all-gather": 18, "reduce-scatter": 14,
+                   "all-to-all": 14, "collective-permute": 90},
         ),
         AuditTarget(
             name="fsdp_1x8",
             mesh_shape={"data": 8},
             build=_fsdp_parts,
-            allow={"all-gather": 24, "reduce-scatter": 16,
-                   "all-to-all": 0, "collective-permute": 8},
+            allow={"all-gather": 30, "reduce-scatter": 8,
+                   "all-to-all": 24, "collective-permute": 8},
         ),
         AuditTarget(
             name="tp_2x4_eval",
             mesh_shape={"data": 2, "model": 4},
             build=_tp_eval_parts,
             optimizer_slots=0,
-            allow={"all-gather": 8, "reduce-scatter": 8,
-                   "all-to-all": 0, "collective-permute": 24},
+            allow={"all-gather": 12, "reduce-scatter": 8,
+                   "all-to-all": 4, "collective-permute": 40},
         ),
         AuditTarget(
             name="badrules",

@@ -226,7 +226,57 @@ class Module(Dispatcher):
         schedule = schedulers[0].schedule if schedulers else None
         base_lr = optimizers[0].learning_rate if optimizers else None
         clip_norm = optimizers[0].clip_norm if optimizers else None
+        self._opt_capsule = optimizers[0] if optimizers else None
         return objective, opt, schedule, base_lr, clip_norm
+
+    def _grad_sync_plan(self):
+        """Route the train step's gradient reduction through the
+        bucketed async reduce-scatter (``parallel.grad_sync``)?
+
+        Returns the kwargs for ``value_and_grad_sharded`` or None for
+        the plain GSPMD reduction. Engages only where the explicit
+        formulation is known-equivalent: a pure data-parallel mesh (the
+        manual region owns every partitioned axis), no gradient
+        accumulation (the accumulator holds REDUCED grads), and no
+        batch-dependent model state (BatchNorm's cross-replica stats
+        are GSPMD reductions inside the forward — a manual data region
+        would silently localize them).
+        """
+        from rocket_tpu.parallel.collectives import overlap_enabled
+
+        opt_capsule = getattr(self, "_opt_capsule", None)
+        if opt_capsule is None or opt_capsule.grad_sync == "off":
+            return None
+        if not overlap_enabled():
+            return None
+        runtime = self._runtime
+        mesh = runtime.mesh
+        data_axes = tuple(runtime.DATA_AXES)
+        import numpy as _np
+
+        n = int(_np.prod([
+            mesh.shape[a] for a in data_axes if a in mesh.shape
+        ] or [1]))
+        non_data = [
+            a for a in mesh.axis_names
+            if a not in data_axes and int(mesh.shape[a]) > 1
+        ]
+        if n <= 1 or non_data:
+            return None
+        if runtime.gradient_accumulation_steps > 1:
+            return None
+        if jax.tree_util.tree_leaves(self._prepared.state["model_state"]):
+            return None
+        marker = getattr(self._param_sharding, "fsdp_axis", None)
+        if opt_capsule.grad_sync == "auto" and marker is None:
+            return None
+        return dict(
+            mesh=mesh,
+            data_axes=data_axes,
+            spec_fn=self._param_sharding,
+            bucket_bytes=opt_capsule.grad_bucket_bytes,
+            wire_dtype=opt_capsule.grad_wire_dtype,
+        )
 
     # -- events ------------------------------------------------------------
 
@@ -457,6 +507,35 @@ class Module(Dispatcher):
             variables = {"params": params, "state": model_state}
             return model.apply(variables, batch, mode=mode, rng=rng)
 
+        # Overlapped TP collectives: when the param_sharding rule set
+        # carries the tp_axis marker (gpt2_tp_rules does) and the mesh
+        # has that axis, the forward traces under the tp_overlap context
+        # — layers swap GSPMD's blocking all-reduces for the ring-
+        # pipelined all-gather/reduce-scatter matmuls
+        # (parallel/collectives.py). ROCKET_TPU_OVERLAP=0 restores the
+        # plain program; the context manager no-ops when the axis is
+        # absent or size 1.
+        tp_axis = getattr(self._param_sharding, "tp_axis", None)
+        if tp_axis is not None:
+            from rocket_tpu.parallel.collectives import tp_overlap
+
+            runtime = self._runtime
+            mesh = runtime.mesh
+            vocab_sharded = bool(
+                getattr(self._param_sharding, "tp_vocab_sharded", False)
+            )
+            data_axes = tuple(runtime.DATA_AXES)
+            tp_inner = forward
+
+            def forward(params, model_state, batch, *, mode, rng):  # noqa: F811
+                with tp_overlap(
+                    mesh, axis=tp_axis, data_axes=data_axes,
+                    vocab_sharded_embed=vocab_sharded,
+                ):
+                    return tp_inner(
+                        params, model_state, batch, mode=mode, rng=rng
+                    )
+
         remat = self._remat
         cfg = getattr(self._model, "config", None)
         if (
@@ -496,6 +575,15 @@ class Module(Dispatcher):
                     "train step: model-provided pipelined value_and_grad "
                     "(1F1B schedule)"
                 )
+        grad_sync_plan = (
+            None if custom_vag is not None else self._grad_sync_plan()
+        )
+        if grad_sync_plan is not None:
+            self.log_info(
+                "train step: bucketed async grad reduce-scatter "
+                f"(wire={grad_sync_plan['wire_dtype']}, "
+                f"bucket={grad_sync_plan['bucket_bytes'] >> 20}MiB)"
+            )
         lr_fn = self._lr_fn
         return_out = self._return_outputs == "always"
         ema_decay = self._ema_decay
@@ -539,6 +627,30 @@ class Module(Dispatcher):
             if custom_vag is not None:
                 (loss, (out, mstate)), grads = custom_vag(
                     state["params"], state["model_state"], batch, rng
+                )
+            elif grad_sync_plan is not None:
+                # Bucketed async gradient reduce-scatter: the backward
+                # runs inside a manual data region and each bucket's
+                # reduction issues as the walk retires it
+                # (parallel/grad_sync.py). Grads come back already
+                # globally reduced — sharded where the rules shard the
+                # param, full elsewhere — so the update below is
+                # unchanged.
+                from rocket_tpu.parallel import grad_sync as grad_sync_lib
+
+                def loss_fn_gs(params, dbatch):
+                    out, mstate = forward(
+                        params, state["model_state"], dbatch,
+                        mode="train", rng=rng,
+                    )
+                    loss = objective(out)
+                    return loss.astype(jnp.float32), (out, mstate)
+
+                (loss, (out, mstate)), grads = (
+                    grad_sync_lib.value_and_grad_sharded(
+                        loss_fn_gs, state["params"], batch,
+                        has_aux=True, **grad_sync_plan,
+                    )
                 )
             else:
 

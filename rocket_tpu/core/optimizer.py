@@ -32,6 +32,9 @@ class Optimizer(Capsule):
         opt: Union[optax.GradientTransformation, "callable"],
         learning_rate: Optional[float] = None,
         clip_norm: Optional[float] = None,
+        grad_sync: str = "auto",
+        grad_bucket_mb: float = 4.0,
+        grad_wire_dtype: Optional[str] = "bfloat16",
         statefull: bool = False,
         priority: int = 1000,
         runtime=None,
@@ -39,11 +42,34 @@ class Optimizer(Capsule):
         """``clip_norm``: clip gradients to this global L2 norm before the
         update (the torch-world ``accelerator.clip_grad_norm_`` step, which
         the reference leaves to user code); compiled into the jitted step
-        ahead of the update rule."""
+        ahead of the update rule.
+
+        ``grad_sync``: the data-parallel gradient-reduction strategy.
+        ``"auto"`` (default) replaces GSPMD's monolithic fp32 grad
+        all-reduce with the bucketed async reduce-scatter
+        (``parallel.grad_sync``) whenever the Module's ``param_sharding``
+        rule set carries the ``fsdp_axis`` marker (``fsdp_rules`` sets
+        it) and the step qualifies (pure data mesh, no batch-dependent
+        model state, no accumulation); ``"bucketed"`` forces it for any
+        qualifying data-parallel step (marker or not); ``"off"`` keeps
+        the GSPMD reduction. ``grad_bucket_mb`` sizes the buckets;
+        ``grad_wire_dtype`` is the ICI wire dtype for gradient payloads
+        (None = master precision; the default bf16 carries the fp32
+        bucket-sum correction and is certified to the precision auditor
+        — see docs/distributed.md).
+        """
+        if grad_sync not in ("auto", "bucketed", "off"):
+            raise ValueError(
+                f"Optimizer: grad_sync must be auto|bucketed|off, got "
+                f"{grad_sync!r}"
+            )
         super().__init__(statefull=statefull, priority=priority, runtime=runtime)
         self._opt = opt
         self._learning_rate = learning_rate
         self._clip_norm = clip_norm
+        self._grad_sync = grad_sync
+        self._grad_bucket_mb = float(grad_bucket_mb)
+        self._grad_wire_dtype = grad_wire_dtype
         self._iter_idx = 0
 
     @property
@@ -57,6 +83,18 @@ class Optimizer(Capsule):
     @property
     def learning_rate(self) -> Optional[float]:
         return self._learning_rate
+
+    @property
+    def grad_sync(self) -> str:
+        return self._grad_sync
+
+    @property
+    def grad_bucket_bytes(self) -> int:
+        return int(self._grad_bucket_mb * (1 << 20))
+
+    @property
+    def grad_wire_dtype(self) -> Optional[str]:
+        return self._grad_wire_dtype
 
     @property
     def iter_idx(self) -> int:

@@ -421,7 +421,46 @@ class Block(Layer):
             h = self._mlp(params["mlp"], h)
         return x + h, k_pages, v_pages
 
+    def _mlp_tp_spec(self, h):
+        """Overlap spec when the MLP can take the collective-matmul
+        path: (B, T, D) input with T and the hidden width dividing the
+        TP axis."""
+        if h.ndim != 3:
+            return None
+        from rocket_tpu.parallel import collectives as coll
+
+        spec = coll.current_tp()
+        if spec is None:
+            return None
+        n = spec.tp_size
+        if h.shape[1] % n or self.fc_in.out_features % n:
+            return None
+        return spec
+
     def _mlp(self, p, h):
+        spec = self._mlp_tp_spec(h)
+        if spec is not None:
+            # Overlapped TP path: ONE gather feeds both column-parallel
+            # projections (swiglu's gate+up share it), the activation
+            # runs on the local hidden shard, and fc_out reduce-scatters
+            # onto the sequence shards (parallel/collectives.py).
+            from rocket_tpu.parallel import collectives as coll
+
+            dt = h.dtype
+            ws = [p["fc_in"]["w"].astype(dt)]
+            if self.mlp_type == "swiglu":
+                ws.append(p["fc_gate"]["w"].astype(dt))
+            outs = coll.all_gather_matmul(spec, h, tuple(ws))
+            up = outs[0] + p["fc_in"]["b"].astype(dt)
+            if self.mlp_type == "swiglu":
+                gate = outs[1] + p["fc_gate"]["b"].astype(dt)
+                hid = jax.nn.silu(gate) * up
+            else:
+                hid = jax.nn.gelu(up)
+            return coll.matmul_reduce_scatter(
+                spec, hid, p["fc_out"]["w"].astype(dt),
+                bias=p["fc_out"]["b"].astype(dt),
+            )
         up, _ = self.fc_in.apply({"params": p["fc_in"], "state": {}}, h)
         if self.mlp_type == "swiglu":
             gate, _ = self.fc_gate.apply({"params": p["fc_gate"], "state": {}}, h)
@@ -808,6 +847,19 @@ class TransformerLM(Model):
         self._pipe_vag[objective] = vag
         return vag
 
+    def _tp_spec(self, t: int):
+        """Active TP-overlap spec for this forward (None = plain GSPMD
+        program). Pipelined models are excluded — the stage shard_map
+        owns the mesh there."""
+        if self.config.pipeline_axis:
+            return None
+        from rocket_tpu.parallel import collectives as coll
+
+        spec = coll.current_tp()
+        if spec is None or t % spec.tp_size:
+            return None
+        return spec
+
     def apply(self, variables, batch, *, mode="train", rng=None):
         p = variables["params"]
         tokens = batch[self.tokens_key]
@@ -817,9 +869,39 @@ class TransformerLM(Model):
                 f"sequence length {t} > max_seq_len {self.config.max_seq_len}"
             )
 
-        x = jnp.take(p["wte"]["table"], tokens, axis=0)
-        if self.wpe is not None:
-            x = x + p["wpe"]["table"][:t]
+        tp_spec = self._tp_spec(t)
+        if tp_spec is not None:
+            # Overlapped TP path: the residual stream runs SEQUENCE-
+            # SHARDED over the TP axis from the embedding to the head —
+            # norms/residual adds touch 1/n of the tokens and every
+            # block-boundary collective is an explicit gather/scatter
+            # (parallel/collectives.py) instead of a GSPMD all-reduce.
+            from rocket_tpu.parallel import collectives as coll
+
+            if (
+                tp_spec.vocab_sharded_embed
+                and self.config.vocab_size % tp_spec.tp_size == 0
+                and self.wpe is None
+            ):
+                # Vocab-parallel lookup reduce-scattered straight onto
+                # the sequence shards. Each row has exactly ONE nonzero
+                # contribution, so crossing at the activation dtype is
+                # bitwise-equal to cast-after-psum — but it narrows a
+                # PARAM (the fp32 master table) on the wire, which
+                # prec_audit RKT403 flags unless the step certifies it.
+                x = coll.embed_lookup_sharded(
+                    tp_spec, p["wte"]["table"], tokens,
+                    compute_dtype=self.config.activation_dtype,
+                )
+            else:
+                x = jnp.take(p["wte"]["table"], tokens, axis=0)
+                if self.wpe is not None:
+                    x = x + p["wpe"]["table"][:t]
+                x = coll.seq_shard(tp_spec, x)
+        else:
+            x = jnp.take(p["wte"]["table"], tokens, axis=0)
+            if self.wpe is not None:
+                x = x + p["wpe"]["table"][:t]
         if self.config.activation_dtype is not None:
             x = x.astype(self.config.activation_dtype)
         if self.drop is not None:
@@ -887,6 +969,36 @@ class TransformerLM(Model):
             and t > 1
             and t % self.config.loss_chunk == 0
         )
+        if tp_spec is not None:
+            from rocket_tpu.parallel import collectives as coll
+
+            if (
+                not fused
+                and self.config.vocab_size % tp_spec.tp_size == 0
+            ):
+                # Head projection as a collective matmul: gather the
+                # sequence shards into the vocab-sharded logits (tied
+                # and untied heads are the same column-parallel shape).
+                w_head = (
+                    p["head"]["w"]
+                    if self.head is not None
+                    else p["wte"]["table"].T
+                )
+                (logits,) = coll.all_gather_matmul(
+                    tp_spec, x, (w_head.astype(x.dtype),)
+                )
+                out[self.logits_key] = logits
+                if moe:
+                    out["moe_aux_loss"] = aux_total * self.config.moe_aux_weight
+                    if dropped_total is not None:
+                        out["moe_frac_dropped"] = (
+                            dropped_total / self.config.num_layers
+                        )
+                return out, variables["state"]
+            # Fused-loss scan (or an indivisible vocab): reassemble the
+            # full sequence first; the gradient crosses back compressed
+            # (seq_all_gather's backward is a wire-dtype relayout).
+            x = coll.seq_all_gather(tp_spec, x)
         if fused:
             if self.head is not None:
                 hp = p["head"]

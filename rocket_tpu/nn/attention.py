@@ -419,9 +419,108 @@ class MultiHeadAttention(Layer):
             causal=self.causal,
         )
 
+    def _tp_spec(self, t: int):
+        """The active TP-overlap spec when the overlapped projection path
+        can serve this call: sequence and head counts divide the TP axis
+        and the attention core keeps whole heads per device. The ring
+        impl is excluded — it shards the SEQUENCE through attention,
+        which is the opposite layout."""
+        if self.impl == "ring":
+            return None
+        from rocket_tpu.parallel import collectives as coll
+
+        spec = coll.current_tp()
+        if spec is None:
+            return None
+        n = spec.tp_size
+        if t % n or self.num_heads % n or self.num_kv_heads % n:
+            return None
+        return spec
+
+    def _apply_tp(self, spec, p, x, mode, rng):
+        """Overlapped TP path: x arrives SEQUENCE-SHARDED over the TP
+        axis; one ring/bulk all-gather feeds all three head-sharded
+        projections, attention runs on whole local heads, and the output
+        projection reduce-scatters straight back onto the sequence
+        shards (``parallel/collectives.py`` — backward runs the
+        transposed rings with the gradient wire dtype)."""
+        from rocket_tpu.parallel import collectives as coll
+
+        b, t, _ = x.shape
+        dt = x.dtype
+        hw = self.num_heads * self.head_dim
+        kvw = self.num_kv_heads * self.head_dim
+        # Head-aligned weight views via ONE gathered copy (bias riding
+        # along) — global slicing of the fused kernel would make GSPMD
+        # reshard every slice every step.
+        wq, wk, wv, bq, bk, bv = coll.qkv_fused_views(
+            spec, p["qkv"]["w"].astype(dt),
+            p["qkv"]["b"].astype(dt) if "b" in p["qkv"] else None,
+            hw, kvw,
+        )
+        q2, k2, v2 = coll.all_gather_matmul(spec, x, (wq, wk, wv))
+        if bq is not None:
+            q2 = q2 + bq
+            k2 = k2 + bk
+            v2 = v2 + bv
+        if self.rope:
+            q2 = apply_rope_bthd(
+                q2.reshape(b, t, self.num_heads, self.head_dim),
+                0, self.rope_base,
+            ).reshape(b, t, hw)
+            k2 = apply_rope_bthd(
+                k2.reshape(b, t, self.num_kv_heads, self.head_dim),
+                0, self.rope_base,
+            ).reshape(b, t, kvw)
+        impl = resolve_impl(
+            self.impl, t, self.head_dim, b, self.num_heads,
+            self.num_kv_heads, mesh=self._flash_mesh,
+        )
+        if impl == "flash":
+            out = self._flash_bthd(q2, k2, v2)          # (B, T, H*D)
+            out = out.reshape(b, t, self.num_heads, self.head_dim)
+        else:
+            q = jnp.moveaxis(
+                q2.reshape(b, t, self.num_heads, self.head_dim), 1, 2
+            )
+            k = jnp.moveaxis(
+                k2.reshape(b, t, self.num_kv_heads, self.head_dim), 1, 2
+            )
+            v = jnp.moveaxis(
+                v2.reshape(b, t, self.num_kv_heads, self.head_dim), 1, 2
+            )
+            if self.num_kv_heads != self.num_heads:
+                out = grouped_dot_product_attention(q, k, v, causal=self.causal)
+            else:
+                out = dot_product_attention(q, k, v, causal=self.causal)
+            out = jnp.moveaxis(out, 1, 2)               # (B, T, H, D)
+        out = self._attn_dropout(out, mode, rng)
+        out = out.reshape(b, t, self.features)
+        y = coll.matmul_reduce_scatter(
+            spec, out, p["proj"]["w"].astype(dt),
+            bias=p["proj"]["b"].astype(dt) if "b" in p["proj"] else None,
+        )
+        return y
+
+    def _attn_dropout(self, out, mode, rng):
+        """Attention-output dropout shared by the plain (_finish) and
+        overlapped (_apply_tp) tails — one implementation, one rng salt."""
+        if not (self.dropout and mode == "train"):
+            return out
+        if rng is None:
+            raise ValueError("MultiHeadAttention: dropout needs rng in train")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(
+            jax.random.fold_in(rng, 1), keep, out.shape
+        )
+        return jnp.where(mask, out / keep, 0.0).astype(out.dtype)
+
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
         b, t, _ = x.shape
+        spec = self._tp_spec(t)
+        if spec is not None:
+            return self._apply_tp(spec, p, x, mode, rng), variables["state"]
         fused, _ = self.qkv.apply({"params": p["qkv"], "state": {}}, x)
         impl = resolve_impl(
             self.impl, t, self.head_dim, b, self.num_heads, self.num_kv_heads,
@@ -473,15 +572,7 @@ class MultiHeadAttention(Layer):
 
     def _finish(self, p, out, b, t, mode, rng):
         """Shared tail: attention dropout, head merge, output projection."""
-        if self.dropout and mode == "train":
-            if rng is None:
-                raise ValueError("MultiHeadAttention: dropout needs rng in train")
-            keep = 1.0 - self.dropout
-            mask = jax.random.bernoulli(
-                jax.random.fold_in(rng, 1), keep, out.shape
-            )
-            out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
-
+        out = self._attn_dropout(out, mode, rng)
         out = out.reshape(b, t, self.features)
         out, _ = self.proj.apply({"params": p["proj"], "state": {}}, out)
         return out

@@ -10,7 +10,7 @@ precision when the activations are bfloat16).
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence, Union
+from typing import Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -41,17 +41,34 @@ def _pair(v: Union[int, Sequence[int]]) -> tuple[int, int]:
 
 
 class Dense(Layer):
+    """``tp_role`` opts a layer into the overlapped collective-matmul
+    path (``parallel/collectives.py``) when a TP-overlap context is
+    active: ``"column"`` (kernel output-dim sharded — the layer gathers
+    its sequence-sharded input into the matmul), ``"row"`` (kernel
+    input-dim sharded — the layer reduce-scatters its output onto the
+    sequence shards). The role only ACTS under an active context with
+    compatible shapes; otherwise the layer is the plain matmul. The
+    transformer Block/attention wire their projections through the
+    grouped primitives directly (one shared gather for fused QKV /
+    swiglu), so their Dense sublayers keep ``tp_role=None``."""
+
     def __init__(
         self,
         in_features: int,
         out_features: int,
         use_bias: bool = True,
         kernel_init: Callable = jax.nn.initializers.lecun_normal(),
+        tp_role: Optional[str] = None,
     ):
+        if tp_role not in (None, "column", "row"):
+            raise ValueError(
+                f"Dense: tp_role must be None|'column'|'row', got {tp_role!r}"
+            )
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = use_bias
         self.kernel_init = kernel_init
+        self.tp_role = tp_role
 
     def init_params(self, key):
         params = {
@@ -61,10 +78,38 @@ class Dense(Layer):
             params["b"] = jnp.zeros((self.out_features,), jnp.float32)
         return params
 
+    def _tp_spec(self, x):
+        """The active overlap spec when this layer's role can engage on
+        ``x`` — (B, T, F) activations whose sequence and the sharded
+        kernel dim both divide the TP axis."""
+        if self.tp_role is None or x.ndim != 3:
+            return None
+        from rocket_tpu.parallel import collectives as coll
+
+        spec = coll.current_tp()
+        if spec is None:
+            return None
+        n = spec.tp_size
+        sharded_dim = (
+            self.out_features if self.tp_role == "column" else self.in_features
+        )
+        if x.shape[1] % n or sharded_dim % n:
+            return None
+        return spec
+
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
         w = p["w"].astype(x.dtype)
-        y = x @ w
+        spec = self._tp_spec(x)
+        if spec is not None:
+            from rocket_tpu.parallel import collectives as coll
+
+            if self.tp_role == "column":
+                (y,) = coll.all_gather_matmul(spec, x, (w,))
+            else:
+                y = coll.matmul_reduce_scatter(spec, x, w)
+        else:
+            y = x @ w
         if self.use_bias:
             y = y + p["b"].astype(x.dtype)
         return y, variables["state"]

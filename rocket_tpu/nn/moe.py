@@ -142,6 +142,28 @@ class MoE(Layer):
 
     def apply(self, variables, x, *, mode="train", rng=None):
         p = variables["params"]
+        # Under the TP-overlap context the residual stream arrives
+        # SEQUENCE-SHARDED over the TP axis; routing groups span the full
+        # sequence, so the layer gathers its input once at the boundary
+        # and re-shards the combined output (parallel/collectives.py —
+        # the backward relayouts cross at the gradient wire dtype). The
+        # expert einsums inside stay GSPMD's to lower (all-to-alls under
+        # an 'expert' mesh axis, exactly as before).
+        from rocket_tpu.parallel import collectives as coll
+
+        tp_spec = coll.current_tp()
+        if tp_spec is not None and x.ndim == 3 and (
+            x.shape[1] % tp_spec.tp_size == 0
+        ):
+            x = coll.seq_all_gather(tp_spec, x)
+        else:
+            tp_spec = None
+        y, aux = self._apply_inner(p, x, mode=mode, rng=rng)
+        if tp_spec is not None:
+            y = coll.seq_shard(tp_spec, y)
+        return y, aux
+
+    def _apply_inner(self, p, x, *, mode="train", rng=None):
         b, t, d = x.shape
         e, k = self.num_experts, self.top_k
 

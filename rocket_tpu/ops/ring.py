@@ -1,0 +1,85 @@
+"""Ring chunk scheduling for the overlapped collective matmuls.
+
+Pure index math shared by ``parallel/collectives.py``: a ring over a mesh
+axis of size ``n`` moves one per-device chunk per ``ppermute`` hop, and
+the compute fused between hops must know, at every step, WHICH global
+chunk it is holding. These helpers are the single source of truth for
+that bookkeeping (the integer identities are pinned in
+``tests/test_collectives.py`` against a brute-force simulation):
+
+* forward ring: device ``i`` sends to ``(i+1) % n`` every hop, so after
+  ``s`` hops device ``d`` holds the chunk that STARTED on ``(d-s) % n``;
+* all-gather ring: chunks are collected in arrival order and re-indexed
+  into global order at the end (:func:`gather_order` — a pure gather, no
+  arithmetic, so the fused matmul stays bitwise-identical to
+  gather-then-matmul);
+* reduce-scatter ring: the accumulator that finally lands on device
+  ``d`` must visit every OTHER device first, so device ``d`` seeds it
+  with the partial for chunk ``(d-1) % n`` and, after hop ``s``, adds its
+  own partial for chunk :func:`rs_chunk_index` ``(d, s, n)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+__all__ = [
+    "fwd_perm",
+    "gather_order",
+    "rs_seed_index",
+    "rs_chunk_index",
+    "use_ring",
+]
+
+
+def fwd_perm(n: int) -> List[Tuple[int, int]]:
+    """``ppermute`` pairs for the forward ring: ``i -> (i+1) % n``."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def gather_order(d, n: int):
+    """Global-order gather indices for an all-gather ring.
+
+    After ``s`` hops device ``d`` holds the chunk from ``(d-s) % n``, so
+    the arrival-order stack ``arr`` satisfies ``arr[(d-j) % n] == global
+    chunk j``. Returns the index vector ``(d - arange(n)) % n`` — taking
+    the stack along axis 0 with it yields global order. ``d`` may be a
+    traced ``axis_index`` scalar.
+    """
+    import jax.numpy as jnp
+
+    return (d - jnp.arange(n)) % n
+
+
+def rs_seed_index(d, n: int):
+    """Chunk index device ``d`` seeds its reduce-scatter accumulator
+    with: ``(d-1) % n`` (the chunk farthest from home — it must travel
+    ``n-1`` hops to reach its destination)."""
+    return (d - 1) % n
+
+
+def rs_chunk_index(d, s: int, n: int):
+    """Chunk index device ``d`` adds to the accumulator it RECEIVED at
+    hop ``s`` (``s = 1 .. n-1``): ``(d - s - 1) % n``. At the final hop
+    this is ``d``'s own chunk, completing the sum that stays home."""
+    return (d - s - 1) % n
+
+
+def use_ring(shard_bytes: int, mode: str, min_ring_bytes: int) -> bool:
+    """Static ring-vs-bulk decision for one collective matmul.
+
+    ``"ring"`` / ``"bulk"`` force; ``"auto"`` rings only when the
+    per-hop chunk is big enough (``min_ring_bytes``) that its transfer
+    can hide real compute — below that the n-1 per-hop launch latencies
+    dominate and one bulk collective (all-gather / reduce-scatter) is
+    strictly better. The threshold is a host-side heuristic resolved at
+    trace time; both paths are numerically interchangeable (the ring is
+    bitwise for gathers, reduction-order-shifted for scatters).
+    """
+    if mode == "ring":
+        return True
+    if mode == "bulk":
+        return False
+    if mode != "auto":
+        raise ValueError(f"ring mode must be ring|bulk|auto, got {mode!r}")
+    return shard_bytes >= min_ring_bytes

@@ -109,8 +109,15 @@ def gpt2_tp_rules(axis: str = "model") -> RuleFn:
     XLA inserts the psum on the residual add. Embedding table sharded over
     the vocab dim (the tied-head einsum reduces over the model dim, so only
     the logits all-gather crosses devices).
+
+    The returned rule fn carries ``tp_axis`` / ``tp_vocab_sharded``
+    markers: ``core.Module`` reads them to activate the overlapped
+    collective-matmul context (``parallel.collectives.tp_overlap``) for
+    models trained under this rule set — the ring-pipelined all-gather /
+    reduce-scatter path replaces GSPMD's blocking all-reduces
+    (``ROCKET_TPU_OVERLAP=0`` restores the plain program).
     """
-    return make_rules(
+    rule_fn = make_rules(
         [
             ("*/attn/qkv/w", (None, axis)),
             ("*/attn/qkv/b", (axis,)),
@@ -124,6 +131,10 @@ def gpt2_tp_rules(axis: str = "model") -> RuleFn:
             ("head/w", (None, axis)),
         ]
     )
+    #: Overlap-context markers (consumed by core.Module / the audits).
+    rule_fn.tp_axis = axis
+    rule_fn.tp_vocab_sharded = True
+    return rule_fn
 
 
 def fsdp_rules(
@@ -146,6 +157,11 @@ def fsdp_rules(
             spec = (None, axis) + (None,) * (len(shape) - 2)
         return spec
 
+    #: Marker for the bucketed async grad reduce-scatter path
+    #: (``parallel.grad_sync``): grads of this layout reduce-scatter per
+    #: bucket and stay sharded (the update runs on the local shard).
+    rule_fn.fsdp_axis = axis
+    rule_fn.fsdp_min_size = min_size
     return rule_fn
 
 

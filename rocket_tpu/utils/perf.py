@@ -31,13 +31,21 @@ class DeviceSpec:
 
     ``flops_bf16`` matches :data:`PEAK_FLOPS`. ``hbm_bw`` and ``ici_bw``
     are bytes/second — HBM read+write bandwidth and aggregate one-way
-    inter-chip bandwidth per chip (all links). ``vmem_bytes`` is a
-    CONSERVATIVE per-core scratch budget for pallas kernels, not the
-    hardware maximum — a kernel fitting this budget leaves the compiler
-    headroom for its own spills. ``hbm_bytes`` is the per-chip HBM
-    CAPACITY (the published figure; the serving auditor's RKT603 fit
-    check budgets against it). ``ridge`` (FLOPs/byte) is the arithmetic
-    intensity above which a kernel is compute-bound.
+    inter-chip bandwidth per chip (all links). ``ici_link_bw`` is ONE
+    link's one-way bandwidth (aggregate / link count): a bulk collective
+    (XLA's multi-dimensional rings) drives every link at once and is
+    priced at the aggregate, but an explicit ``ppermute`` ring hop moves
+    its chunk over a single link — the schedule auditor prices those
+    hop-by-hop against this column. ``dcn_bw`` is the per-chip
+    data-center-network egress bandwidth, the denominator for
+    CROSS-SLICE collectives (multi-slice data parallelism — ROADMAP
+    item 5); ICI never leaves a slice. ``vmem_bytes`` is a CONSERVATIVE
+    per-core scratch budget for pallas kernels, not the hardware
+    maximum — a kernel fitting this budget leaves the compiler headroom
+    for its own spills. ``hbm_bytes`` is the per-chip HBM CAPACITY (the
+    published figure; the serving auditor's RKT603 fit check budgets
+    against it). ``ridge`` (FLOPs/byte) is the arithmetic intensity
+    above which a kernel is compute-bound.
     """
 
     kind: str
@@ -46,6 +54,13 @@ class DeviceSpec:
     ici_bw: float
     vmem_bytes: int
     hbm_bytes: int = 16 << 30
+    ici_link_bw: float = 0.0
+    dcn_bw: float = 25e9
+
+    def __post_init__(self):
+        if not self.ici_link_bw:
+            # Fallback for ad-hoc specs: a 2D-torus chip has 4 links.
+            object.__setattr__(self, "ici_link_bw", self.ici_bw / 4)
 
     @property
     def ridge(self) -> float:
@@ -55,20 +70,24 @@ class DeviceSpec:
 #: Roofline constants by device kind (same longest-prefix matching as
 #: PEAK_FLOPS). Bandwidths are the published per-chip figures; treat
 #: them as ranking constants for the static cost model, not measured
-#: achievable bandwidth.
+#: achievable bandwidth. Link counts: v4/v5p/v7 are 3D tori (6 links),
+#: v5e/v6e 2D (4 links); DCN is the per-chip share of the published
+#: slice egress — a conservative ranking constant.
 DEVICE_SPECS = {
     spec.kind: spec
     for spec in (
-        DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 16 << 20, 32 << 30),
+        DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 16 << 20, 32 << 30,
+                   ici_link_bw=50e9, dcn_bw=25e9),
         DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 16 << 20,
-                   16 << 30),                                        # v5e
+                   16 << 30, ici_link_bw=50e9, dcn_bw=25e9),         # v5e
         DeviceSpec("TPU v5", 459e12, 2765e9, 600e9, 16 << 20,
-                   95 << 30),                                        # v5p
+                   95 << 30, ici_link_bw=100e9, dcn_bw=50e9),        # v5p
         DeviceSpec("TPU v6 lite", 918e12, 1638e9, 448e9, 32 << 20,
-                   32 << 30),                                        # v6e
-        DeviceSpec("TPU v6", 918e12, 1638e9, 448e9, 32 << 20, 32 << 30),
+                   32 << 30, ici_link_bw=112e9, dcn_bw=50e9),        # v6e
+        DeviceSpec("TPU v6", 918e12, 1638e9, 448e9, 32 << 20, 32 << 30,
+                   ici_link_bw=112e9, dcn_bw=50e9),
         DeviceSpec("TPU v7", 2307e12, 7370e9, 1200e9, 32 << 20,
-                   192 << 30),
+                   192 << 30, ici_link_bw=200e9, dcn_bw=100e9),
     )
 }
 

@@ -49,6 +49,19 @@ echo "== schedule audit (roofline self-gate + schedule budgets) =="
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
     --budgets tests/fixtures/budgets/sched
 
+echo "== overlap true-positive (seeded-bad badoverlap demo) =="
+# The overlapped-collective rules must still FIND the unoverlapped
+# shape they were built to kill: the seeded-bad per-param grad-psum
+# convoy + sync all-gather demo must report RKT501 AND RKT502.
+if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
+        --target badoverlap >/tmp/_badoverlap.txt 2>&1; then
+    echo "badoverlap demo reported no findings - rules are broken"
+    exit 1
+fi
+grep -q "RKT501" /tmp/_badoverlap.txt && grep -q "RKT502" /tmp/_badoverlap.txt || {
+    echo "badoverlap demo missing RKT501/RKT502:"; cat /tmp/_badoverlap.txt; exit 1;
+}
+
 echo "== serving audit (retrace-surface / latency-roofline / HBM-fit self-gate + serving budgets) =="
 # AOT-compiles the real decode-wave/prefill programs and drives the real
 # scheduler through the admission lattice; fails on serving findings

@@ -234,7 +234,7 @@ def test_sched_list_targets():
     proc = run_cli("sched", "--list-targets")
     assert proc.returncode == 0
     for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "dp_resnet_1x8",
-                 "tp_flash", "badsched", "badpallas"):
+                 "tp_flash", "badsched", "badoverlap", "badpallas"):
         assert name in proc.stdout
 
 

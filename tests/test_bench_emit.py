@@ -414,3 +414,42 @@ def test_write_detail_partial_run_keeps_gpt2_headline(tmp_path):
     bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
     detail = json.loads(path.read_text())
     assert detail["headline_metric"] == bench.METRIC_NAMES["gpt2"]
+
+
+def test_write_detail_carries_overlap_record(tmp_path):
+    path = tmp_path / "detail.json"
+    overlap = {
+        "targets": {
+            "tp_1x8": {
+                "overlap": {"collective_bytes_per_step": 7600432,
+                            "exposed_comm_us": 70.0},
+                "baseline": {"collective_bytes_per_step": 14176944,
+                             "exposed_comm_us": 147.5},
+                "bytes_ratio": 1.865,
+                "exposed_comm_drop_frac": 0.5255,
+            }
+        },
+        "device_kind": "TPU v5 lite",
+        "wire_dtype": "bfloat16",
+    }
+    bench.write_detail(
+        {"gpt2": _full_result("gpt2")}, path=str(path), overlap=overlap
+    )
+    detail = json.loads(path.read_text())
+    rec = detail["overlap"]["targets"]["tp_1x8"]
+    assert rec["bytes_ratio"] == 1.865
+    assert rec["exposed_comm_drop_frac"] > 0.4
+    # A later run without the probe must not drop the committed record.
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path))
+    assert "overlap" in json.loads(path.read_text())
+
+
+def test_overlap_summary_shapes_real_targets():
+    summary = bench.overlap_summary(targets=("tp_2x4_eval",))
+    assert summary is not None
+    rec = summary["targets"]["tp_2x4_eval"]
+    assert rec["overlap"]["collective_bytes_per_step"] > 0
+    assert rec["baseline"]["collective_bytes_per_step"] > 0
+    # The overlapped eval forward moves no MORE than the GSPMD baseline.
+    assert rec["bytes_ratio"] >= 1.0
+    assert "exposed_comm_drop_frac" in rec

@@ -8,6 +8,7 @@ import pytest
 from rocket_tpu.utils.perf import (
     DEVICE_SPECS,
     PEAK_FLOPS,
+    DeviceSpec,
     device_spec,
     peak_flops,
 )
@@ -93,3 +94,35 @@ def test_hbm_capacity_is_physical():
     assert DEVICE_SPECS["TPU v7"].hbm_bytes == max(
         s.hbm_bytes for s in DEVICE_SPECS.values()
     )
+
+
+def test_ici_link_bandwidth_rows_are_physical():
+    # The schedule auditor prices explicit ppermute ring hops against
+    # ONE link's bandwidth (a bulk collective drives every link at
+    # once): each row's link bandwidth divides the aggregate by the
+    # generation's link count — 2D tori (v5e/v6e) 4 links, 3D tori
+    # (v4/v5p/v7) 6 — and never exceeds the aggregate.
+    for spec in DEVICE_SPECS.values():
+        assert 0 < spec.ici_link_bw <= spec.ici_bw
+        links = spec.ici_bw / spec.ici_link_bw
+        assert 3.5 <= links <= 6.5, (spec.kind, links)
+    assert DEVICE_SPECS["TPU v5 lite"].ici_link_bw == 50e9
+    assert DEVICE_SPECS["TPU v5"].ici_link_bw == 100e9
+    assert DEVICE_SPECS["TPU v7"].ici_link_bw == 200e9
+
+
+def test_dcn_bandwidth_rows_present():
+    # Cross-slice collectives (multi-slice data parallelism) price
+    # against per-chip DCN egress: far below ICI on every generation,
+    # and newer generations don't regress.
+    for spec in DEVICE_SPECS.values():
+        assert 0 < spec.dcn_bw < spec.ici_link_bw
+    assert DEVICE_SPECS["TPU v5 lite"].dcn_bw == 25e9
+    assert DEVICE_SPECS["TPU v7"].dcn_bw >= DEVICE_SPECS["TPU v4"].dcn_bw
+
+
+def test_ad_hoc_spec_defaults_link_bandwidth():
+    # A user-constructed spec without the link column falls back to a
+    # 4-link split so hop pricing never divides by zero.
+    spec = DeviceSpec("TPU vX", 1e15, 1e12, 4e11, 1 << 20)
+    assert spec.ici_link_bw == pytest.approx(1e11)

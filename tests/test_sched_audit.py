@@ -417,3 +417,90 @@ def test_predict_compiled_record_shape_on_snippet():
     assert record["predicted_step_time_us"] > 0
     assert ideal.makespan_s <= scheduled.makespan_s + 1e-12
     assert record["device_kind"] == "TPU v5 lite"
+
+
+# -- PR 12: permute pricing + async-DMA semantics + badoverlap ---------------
+
+
+def test_collective_permute_priced_per_link():
+    """A ppermute hop moves its chunk over ONE ICI link; bulk
+    collectives drive every link — the same bytes must cost more as a
+    permute than as an all-gather."""
+    from rocket_tpu.analysis.sched_audit import cost_ops, parse_hlo_module
+    from rocket_tpu.utils.perf import device_spec
+
+    spec = device_spec("TPU v5 lite")
+    hlo = """
+HloModule m, is_scheduled=true
+
+ENTRY %main (p: f32[1024,256]) -> f32[1024,256] {
+  %p = f32[1024,256] parameter(0)
+  %perm = f32[1024,256] collective-permute(f32[1024,256] %p), source_target_pairs={{0,1},{1,0}}
+  ROOT %ag = f32[1024,256] all-gather(f32[1024,256] %perm), replica_groups={{0,1}}, dimensions={0}
+}
+"""
+    entry, comps = parse_hlo_module(hlo)
+    ops = {op.name: op for op in cost_ops(entry, comps, spec)}
+    bytes_each = 1024 * 256 * 4
+    # permute: one hop of the full buffer at LINK bandwidth.
+    assert ops["perm"].time_s == pytest.approx(
+        bytes_each / spec.ici_link_bw + 1e-6
+    )
+    # all-gather: ring bytes at AGGREGATE bandwidth.
+    assert ops["ag"].time_s == pytest.approx(
+        (bytes_each // 2) / spec.ici_bw + 1e-6
+    )
+    assert ops["perm"].time_s > ops["ag"].time_s
+
+
+def test_sync_sim_treats_permutes_as_async_dma():
+    """collective-permute is an async DMA on TPU (XLA lowers it to
+    -start/-done there); the CPU dump's sync spelling must not make the
+    simulator block compute on it — only its CONSUMERS wait."""
+    ops = [
+        mk_op("c", "comm", 10e-6, opcode="collective-permute",
+              comm_bytes=1 << 20),
+        mk_op("a", "memory", 6e-6),
+        mk_op("b", "memory", 6e-6),
+        mk_op("d", "memory", 2e-6, operands=("c",)),
+    ]
+    sim = simulate(ops, overlap=False)
+    # a/b run while the permute flies: makespan 12 + 2, exposure 0
+    # (comm_busy never intersects compute idle until d, which is ready
+    # at t=10 < compute_clock 12).
+    assert sim.makespan_s == pytest.approx(14e-6)
+    assert sim.exposed_comm_s == pytest.approx(0.0)
+    # The sync spelling of a bulk collective still blocks.
+    ops2 = [
+        mk_op("c", "comm", 10e-6, opcode="all-reduce",
+              comm_bytes=1 << 20),
+        mk_op("a", "memory", 6e-6),
+    ]
+    sim2 = simulate(ops2, overlap=False)
+    assert sim2.exposed_comm_s == pytest.approx(10e-6)
+
+
+def test_badoverlap_demo_reports_convoy_and_exposure():
+    """The seeded-bad unoverlapped shape — per-param grad psum convoy +
+    a sync all-gather blocking independent compute — must still be
+    NAMED by the rules the overlapped paths were built to satisfy."""
+    report = run_sched_target(SCHED_TARGETS["badoverlap"])
+    found = set(rules_in(report.findings))
+    assert {"RKT501", "RKT502"} <= found, found
+
+
+def test_tp_targets_budget_exposed_comm_dropped():
+    """The committed tp_1x8 schedule budget must hold the overlapped
+    program's exposure: the acceptance floor (>= 40% below the
+    pre-overlap 119.885us) is pinned so a regression cannot be
+    re-committed unnoticed."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "fixtures", "budgets", "sched",
+        "tp_1x8.json",
+    )
+    with open(path) as f:
+        record = json.load(f)
+    assert record["exposed_comm_us"] <= 119.885 * 0.6
