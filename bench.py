@@ -824,6 +824,59 @@ def _sched_calibration(results):
     return entries
 
 
+#: Calibration-budget directory the measured-vs-predicted auditor
+#: maintains (``python -m rocket_tpu.analysis calib --update-budgets``).
+CALIB_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "calib",
+)
+
+
+def calib_summary(budgets_dir=CALIB_BUDGETS_DIR, live=True):
+    """Measured-vs-predicted calibration record for BENCH_DETAIL.json
+    (``rocket_tpu.analysis.calib`` / ``rocket_tpu.obs.prof``).
+
+    Two halves, both best-effort:
+
+    * the committed calibration budgets (the numbers the calib gate
+      verifies every CI run): per-target absolute calibration error +
+      unjoined measured fraction;
+    * a ``live`` capture->parse->reconcile leg re-running the
+      gpt2_sentinel target on THIS machine — a device trace of the real
+      compiled step, bucketed per HLO op and joined against the priced
+      DAG, so the record carries the calibration error measured on this
+      run's hardware (the first real-TPU bench run turns
+      ``device_matched`` True and the error becomes a model-quality
+      number instead of a device-mismatch one).
+    """
+    out = _budget_summary(
+        budgets_dir, "CALIB_GATED_KEYS", "tests/fixtures/budgets/calib"
+    ) or {}
+    if live:
+        try:
+            from rocket_tpu.analysis.calib import (
+                CALIB_TARGETS,
+                run_calib_target,
+            )
+
+            report = run_calib_target(CALIB_TARGETS["gpt2_sentinel"])
+            if report.record:
+                keys = (
+                    "n_steps", "measured_step_us", "predicted_step_us",
+                    "calib_error", "abs_calib_error", "join_coverage",
+                    "measured_exposed_comm_us",
+                    "predicted_exposed_comm_us", "measured_mfu",
+                    "predicted_mfu", "device_kind_measured", "priced_for",
+                    "device_matched",
+                )
+                out["live"] = {"gpt2_sentinel": {
+                    k: report.record.get(k) for k in keys
+                }}
+        except Exception as exc:  # noqa: BLE001 — emission must survive
+            log(f"bench: calib live capture failed: {exc!r}")
+    return out or None
+
+
 #: Tuned-kernel config tables the offline autotuner maintains
 #: (``python -m rocket_tpu.tune --update-table``).
 TUNE_CONFIGS_DIR = os.path.join(
@@ -1335,7 +1388,7 @@ def _carry_calibration(section, prior_section):
 
 
 def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
-                 resilience=None, overlap=None):
+                 resilience=None, overlap=None, calib=None):
     """Full per-config results → a committed repo file. The stdout line
     (``format_line``) carries only the headline + one number per config;
     this file is the complete record it points at.
@@ -1428,6 +1481,16 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
         # target — the comm/compute-overlap win recorded even on
         # CPU-only runs.
         detail["overlap"] = overlap
+    if calib is None:
+        # A probe-less run keeps the committed measured-vs-predicted
+        # record (the live leg needs a capture from THIS run).
+        calib = prior.get("calib")
+    if calib is not None:
+        # Measured-vs-predicted calibration (obs.prof + analysis.calib):
+        # per-target |calibration error| + unjoined fraction from the
+        # committed budgets, plus a live capture->parse->reconcile leg
+        # of the gpt2 sentinel step on this run's hardware.
+        detail["calib"] = calib
     serve_audit = serve_audit_summary(serve, SERVE_BUDGETS_DIR)
     if serve_audit is not None:
         # Statically-predicted serving latency/HBM (serve_audit budgets)
@@ -1593,6 +1656,16 @@ def main():
         if overlap is not None:
             log(f"bench: overlap_summary -> {overlap}")
 
+    # Measured-vs-predicted calibration probe (obs.prof capture of the
+    # gpt2 sentinel step reconciled against the priced DAG) — same
+    # budget discipline.
+    calib = None
+    if time.time() - start <= args.budget_s:
+        log("bench: measured-vs-predicted calibration probe ...")
+        calib = calib_summary()
+        if calib is not None:
+            log(f"bench: calib_summary -> {calib}")
+
     # The stdout line is the hard contract and goes out FIRST — a kill or
     # hang during the best-effort detail write must not eat it. It still
     # ends up last in the tail capture because nothing else prints to
@@ -1600,7 +1673,7 @@ def main():
     print(format_line(results), flush=True)
     try:
         write_detail(results, health=health, serve=serve,
-                     resilience=resilience, overlap=overlap)
+                     resilience=resilience, overlap=overlap, calib=calib)
     except Exception as exc:  # noqa: BLE001 — detail file is best effort
         log(f"bench: could not write {DETAIL_PATH}: {exc!r}")
 

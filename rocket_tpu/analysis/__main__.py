@@ -46,33 +46,14 @@ example inputs, so they run from code/tests via
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 from dataclasses import dataclass
 from typing import Callable
 
+from rocket_tpu.analysis.backend import provision_cpu_backend
 from rocket_tpu.analysis.findings import emit_findings
 from rocket_tpu.analysis.rocketlint import lint_paths
 from rocket_tpu.analysis.rules import all_rules
-
-
-def _provision_cpu_backend() -> None:
-    # The auditors run on fake devices: default to the CPU backend with
-    # 8 virtual devices unless the caller chose a platform. XLA_FLAGS
-    # is read at client creation, so the env is early enough — but jax was
-    # already imported by the package __init__ and froze JAX_PLATFORMS
-    # into its config, so the platform default must go through
-    # jax.config.update (tests/conftest.py does the same).
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    import jax
-
-    if getattr(jax.config, "jax_platforms", None) in (None, ""):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 @dataclass(frozen=True)
@@ -91,6 +72,10 @@ class AuditCLI:
     gated_keys_attr: str
     budget_rule: str
     family: str
+    #: True for audits that MEASURE (run real steps): the backend
+    #: provisioning then prefers a present accelerator instead of
+    #: forcing the CPU default the purely static audits want.
+    measures: bool = False
     #: target -> one-line description for --list-targets
     list_line: Callable[[object], str] = staticmethod(lambda t: "")
 
@@ -123,6 +108,12 @@ def _load_serve():
     )
 
     return SERVE_TARGETS, run_serve_target
+
+
+def _load_calib():
+    from rocket_tpu.analysis.calib import CALIB_TARGETS, run_calib_target
+
+    return CALIB_TARGETS, run_calib_target
 
 
 def _mesh_line(target) -> str:
@@ -187,6 +178,24 @@ AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
                 f"device={t.device_kind} ref_prompt={t.ref_prompt_len}"
             ),
         ),
+        AuditCLI(
+            name="calib",
+            description="measured-vs-predicted calibration: capture a "
+                        "device trace of the canonical steps, bucket it "
+                        "per HLO op, reconcile against the priced "
+                        "optimized-HLO DAG, and gate the drift",
+            load=_load_calib,
+            budgets_dir_attr="CALIB_DIR",
+            gated_keys_attr="CALIB_GATED_KEYS",
+            budget_rule="RKT701",
+            family="calib",
+            measures=True,
+            list_line=lambda t: (
+                f"kind={t.kind} priced_for={t.device_kind}"
+                if t.kind == "train"
+                else f"kind={t.kind} budget=serve/{t.serve_budget}"
+            ),
+        ),
     )
 }
 
@@ -195,7 +204,7 @@ def _audit_main(cli: AuditCLI, argv) -> int:
     """Shared scaffolding for every audit subcommand: one flag set, one
     demo-skip sweep, one budget write/diff loop — so the audit CLIs
     cannot drift apart."""
-    _provision_cpu_backend()
+    provision_cpu_backend(force_cpu_default=not cli.measures)
     from rocket_tpu.analysis import budgets as budgets_mod
 
     targets, run_target = cli.load()

@@ -1,0 +1,47 @@
+"""Fake-backend provisioning shared by the audit-driving CLIs.
+
+The auditors compile on fake devices: both ``python -m
+rocket_tpu.analysis <subcommand>`` and ``python -m rocket_tpu.obs prof
+--target`` (which compiles a calib target's priced DAG) need the CPU
+backend with 8 virtual devices unless the caller already chose a
+platform — one function so the bootstrap cannot drift between CLIs.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["provision_cpu_backend"]
+
+
+def provision_cpu_backend(force_cpu_default: bool = True) -> None:
+    """Provision the audit backend.
+
+    ``force_cpu_default=True`` (the purely static auditors): default to
+    the CPU backend with 8 virtual devices — they only compile, and the
+    fake mesh is the point. XLA_FLAGS is read at client creation, so
+    the env is early enough — but jax was already imported by the
+    package ``__init__`` and froze ``JAX_PLATFORMS`` into its config,
+    so the platform default must go through ``jax.config.update``
+    (tests/conftest.py does the same). A caller-chosen platform (env
+    already set) is respected either way.
+
+    ``force_cpu_default=False`` (the calibration audit — the one that
+    MEASURES): leave jax's own platform default in place so a real
+    accelerator is preferred when present (forcing CPU there would
+    measure the wrong machine and ``device_matched`` could never flip
+    true); only the virtual-device flag is set, so the CPU *fallback*
+    still gets its 8 fake devices on accelerator-less hosts.
+    """
+    if force_cpu_default:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    if force_cpu_default:
+        import jax
+
+        if getattr(jax.config, "jax_platforms", None) in (None, ""):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -44,6 +44,7 @@ __all__ = [
     "PREC_GATED_KEYS",
     "SCHED_GATED_KEYS",
     "SERVE_GATED_KEYS",
+    "CALIB_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -66,6 +67,15 @@ PREC_GATED_KEYS = ("fp32_bytes_fraction", "widen_casts", "narrow_casts")
 #: predicted step time and the exposed (non-overlapped) collective time.
 SCHED_GATED_KEYS = ("predicted_step_time_us", "exposed_comm_us")
 
+#: Record keys the calibration gate compares — RKT701. Both are
+#: monotone badness metrics of the measured-vs-predicted reconciliation
+#: (rocket_tpu.analysis.calib): the absolute calibration error of the
+#: headline quantity (step time for train targets, decode ITL for serve
+#: targets) and the fraction of measured device time that failed to
+#: join the priced DAG by instruction name. Either growing means the
+#: cost model and reality (or the join) are drifting apart.
+CALIB_GATED_KEYS = ("abs_calib_error", "unjoined_fraction")
+
 #: Record keys the serving gate compares — RKT606. All three are
 #: monotone cost metrics of the AOT-compiled serving programs: predicted
 #: inter-token latency (one decode wave), predicted time-to-first-token
@@ -83,6 +93,7 @@ DEFAULT_DIR = os.path.join("tests", "fixtures", "budgets")
 PREC_DIR = os.path.join(DEFAULT_DIR, "prec")
 SCHED_DIR = os.path.join(DEFAULT_DIR, "sched")
 SERVE_DIR = os.path.join(DEFAULT_DIR, "serve")
+CALIB_DIR = os.path.join(DEFAULT_DIR, "calib")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -131,6 +142,7 @@ def diff_budget(
     path = f"<{family}:{target}>"
     subcommand = {
         "spmd": "shard", "sched": "sched", "serve": "serve",
+        "calib": "calib",
     }.get(family, "prec")
     if committed is None:
         return [Finding(

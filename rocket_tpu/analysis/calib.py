@@ -1,0 +1,692 @@
+"""calib — measured-vs-predicted reconciliation that closes the roofline loop.
+
+``sched_audit`` predicts a step's per-op costs from a roofline over the
+optimized HLO; ``serve_audit`` predicts decode ITL the same way. Those
+predictions gate CI — but until now nothing *measured* at the same
+granularity, so the cost model's drift against reality was invisible
+between hardware sessions. This module is the measurement half:
+
+1. **capture** — each calibration target compiles its REAL step with
+   the shared shard_audit harness (same fake mesh, same optimized HLO
+   the schedule auditor prices), executes it for a few
+   ``StepTraceAnnotation``-wrapped steps under a
+   :class:`~rocket_tpu.obs.prof.TraceSession`, and keeps the perfetto
+   trace (default ``runs/prof/<target>/`` — re-renderable any time with
+   ``python -m rocket_tpu.obs prof``);
+2. **parse** — :func:`rocket_tpu.obs.prof.parse_trace` buckets the
+   device slices by HLO op and step window;
+3. **reconcile** — :func:`reconcile` joins measured ops against the
+   priced DAG *by instruction name* (same optimized module, so names
+   match by construction), emitting signed calibration error per
+   roofline category, the top measured-vs-predicted offenders with
+   source attribution, measured MFU and measured exposed communication.
+
+The numbers are budget-gated like every other audit family
+(``tests/fixtures/budgets/calib/``, RKT701 via the shared diff loop;
+RKT702 join-coverage and RKT703 matched-hardware error ceilings are this
+module's own checks) and surfaced three ways: ``python -m
+rocket_tpu.analysis calib``, ``python -m rocket_tpu.obs prof <trace>
+--target <name>``, and ``bench.py``'s ``calib_summary`` record in
+BENCH_DETAIL.json.
+
+On this CPU-only container the measured device kind is unknown to the
+peak tables, so the calibration error is dominated by the device
+mismatch (tracked, budget-pinned, ceiling-skipped); the first real-TPU
+session regenerates the budgets and RKT703 starts gating "predicted
+within Kx of measured" for real — which is what makes the PR-11/12
+roofline claims falsifiable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.rules.calib_rules import (
+    check_error_ceiling,
+    check_join_coverage,
+)
+from rocket_tpu.obs.prof import (
+    TraceSession,
+    TraceSummary,
+    capture_metadata,
+    load_trace_events,
+    parse_trace,
+)
+from rocket_tpu.utils.perf import device_spec
+
+__all__ = [
+    "CalibTarget",
+    "CalibReport",
+    "CALIB_TARGETS",
+    "reconcile",
+    "priced_ops_for_target",
+    "capture_target_trace",
+    "run_calib_target",
+    "render_calib",
+]
+
+#: Reference device kind calibration prices against when a target does
+#: not override it — matches sched_audit's self-gate reference.
+DEFAULT_DEVICE_KIND = "TPU v5 lite"
+
+_HLO_MODULE_RE = re.compile(r"HloModule\s+([\w\.\-]+)")
+
+#: sched_audit OpCost.kind -> measured category vocabulary.
+_KIND_TO_CATEGORY = {"comm": "collective", "compute": "compute",
+                     "memory": "memory"}
+
+
+# -- reconcile ---------------------------------------------------------------
+
+
+def _pick_module(summary: TraceSummary, priced_names) -> Optional[str]:
+    """The trace module whose ops best cover the priced names
+    (time-weighted) — used when the caller doesn't know the compiled
+    module's name."""
+    best, best_time = None, -1.0
+    for module in summary.modules:
+        joined = sum(
+            op.total_us for op in summary.module_ops(module)
+            if op.name in priced_names
+        )
+        if joined > best_time:
+            best, best_time = module, joined
+    return best
+
+
+def reconcile(
+    summary: TraceSummary,
+    priced_ops,
+    priced_record: Mapping,
+    *,
+    module: Optional[str] = None,
+    measured_kind: Optional[str] = None,
+    label: str = "calib",
+    top: int = 10,
+) -> Tuple[dict, list]:
+    """Join measured per-op durations against the priced DAG.
+
+    ``priced_ops`` is the as-compiled simulation's ``OpCost`` list
+    (:func:`rocket_tpu.analysis.sched_audit.predict_compiled`),
+    ``priced_record`` its record. Returns ``(record, rows)``: the
+    calibration record (budget/BENCH shape) and the per-op joined rows.
+    Joined measured ops take the priced op's roofline kind as their
+    category (the cost model's own attribution vocabulary); unjoined
+    ones keep the parser's opcode heuristic.
+
+    The per-op comparand is the measured mean duration PER EXECUTION
+    (``total_us / count``): the priced DAG costs one per-device
+    instance, and on a multi-device capture (the fake mesh's 8 streams
+    in one process, or N TensorCore pids on hardware) each device
+    contributes one slice per step — dividing by the execution count is
+    what keeps the join per-device on both backends. The headline
+    ``measured_step_us`` stays the per-step device SPAN (all streams in
+    parallel), the measured analogue of the simulated makespan.
+    """
+    priced = {
+        op.name: op for op in priced_ops
+        if op.kind != "free" and not op.opcode.endswith("-done")
+    }
+    if module is None:
+        module = _pick_module(summary, set(priced))
+    measured = summary.module_ops(module)
+    n_steps = max(len(summary.steps), 1)
+
+    rows = []
+    joined_us = 0.0
+    measured_total_us = sum(op.total_us for op in measured)
+    meas_by_cat: dict[str, float] = {}
+    pred_by_cat: dict[str, float] = {}
+    for op in measured:
+        priced_op = priced.get(op.name)
+        mean_us = op.total_us / op.count if op.count else 0.0
+        if priced_op is None:
+            meas_by_cat[op.category] = (
+                meas_by_cat.get(op.category, 0.0) + mean_us
+            )
+            continue
+        joined_us += op.total_us
+        category = _KIND_TO_CATEGORY.get(priced_op.kind, priced_op.kind)
+        predicted_us = priced_op.time_s * 1e6
+        meas_by_cat[category] = meas_by_cat.get(category, 0.0) + mean_us
+        rows.append({
+            "name": op.name,
+            "category": category,
+            "measured_us": round(mean_us, 3),
+            "predicted_us": round(predicted_us, 3),
+            "executions_per_step": round(op.count / n_steps, 2),
+            "error": round((predicted_us - mean_us) / mean_us, 4)
+            if mean_us > 0 else None,
+            "where": priced_op.where,
+        })
+    for priced_op in priced.values():
+        category = _KIND_TO_CATEGORY.get(priced_op.kind, priced_op.kind)
+        pred_by_cat[category] = (
+            pred_by_cat.get(category, 0.0) + priced_op.time_s * 1e6
+        )
+
+    categories = {}
+    for cat in sorted(set(meas_by_cat) | set(pred_by_cat)):
+        meas = meas_by_cat.get(cat, 0.0)
+        pred = pred_by_cat.get(cat, 0.0)
+        categories[cat] = {
+            "measured_us": round(meas, 3),
+            "predicted_us": round(pred, 3),
+            "error": round((pred - meas) / meas, 4) if meas > 0 else None,
+        }
+
+    measured_step_us = summary.mean("device_span_us")
+    predicted_step_us = float(
+        priced_record.get("predicted_step_time_us") or 0.0
+    )
+    calib_error = (
+        (predicted_step_us - measured_step_us) / measured_step_us
+        if measured_step_us > 0 else None
+    )
+    join_coverage = (
+        joined_us / measured_total_us if measured_total_us > 0 else 0.0
+    )
+
+    # The kind of the machine that CAPTURED the trace (the sidecar) —
+    # falling back to this process's device only for fresh in-process
+    # captures; a re-render on another host must not claim its own.
+    if measured_kind is None:
+        measured_kind = jax.devices()[0].device_kind
+    spec = device_spec(measured_kind)
+    flops = float(priced_record.get("flops_per_step") or 0.0)
+    measured_mfu = None
+    if spec is not None and measured_step_us > 0 and flops:
+        measured_mfu = round(
+            flops / (measured_step_us * 1e-6 * spec.flops_bf16), 4
+        )
+
+    rows.sort(
+        key=lambda r: -abs(r["measured_us"] - r["predicted_us"])
+    )
+    record = {
+        "module": module or "",
+        "n_steps": len(summary.steps),
+        "n_measured_ops": len(measured),
+        "n_joined_ops": len(rows),
+        "measured_step_us": round(measured_step_us, 3),
+        "wall_step_us": round(summary.mean("wall_us"), 3),
+        "predicted_step_us": round(predicted_step_us, 3),
+        "calib_error": round(calib_error, 4)
+        if calib_error is not None else None,
+        "abs_calib_error": round(abs(calib_error), 4)
+        if calib_error is not None else None,
+        "measured_exposed_comm_us": round(
+            summary.mean("exposed_comm_us"), 3
+        ),
+        "predicted_exposed_comm_us": float(
+            priced_record.get("exposed_comm_us") or 0.0
+        ),
+        "measured_mfu": measured_mfu,
+        "predicted_mfu": priced_record.get("predicted_mfu"),
+        "join_coverage": round(join_coverage, 4),
+        "unjoined_fraction": round(1.0 - join_coverage, 4),
+        "categories": categories,
+        "top_offenders": rows[:top],
+        "device_kind_measured": measured_kind,
+        "priced_for": priced_record.get("device_kind"),
+        "device_matched": spec is not None
+        and spec.kind == priced_record.get("device_kind"),
+    }
+    return record, rows
+
+
+# -- targets -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CalibTarget:
+    """One calibration pairing the CLI runs.
+
+    ``kind == "train"``: ``build`` returns sched-audit-shaped parts
+    ``(step_fn, variables, batch, rules, donate_argnums)``; the step is
+    AOT-compiled on ``mesh_shape``'s fake mesh, priced for
+    ``device_kind``, executed ``warmup + steps`` times (zeros inputs —
+    time depends on shapes, not values) with the last ``steps`` traced,
+    and the trace reconciled against the priced DAG.
+
+    ``kind == "serve"``: ``build`` returns serve-audit-shaped parts
+    ``(model, ServeConfig)``; a real engine serves a small workload
+    with the decode phase traced, and the decode module's measured
+    device time per wave reconciles against the committed serve
+    budget's ``predicted_itl_us`` (``serve_budget`` names the record).
+    """
+
+    name: str
+    kind: str
+    build: Callable[[], tuple]
+    mesh_shape: Mapping[str, int] = field(default_factory=dict)
+    device_kind: str = DEFAULT_DEVICE_KIND
+    steps: int = 4
+    warmup: int = 2
+    join_floor: float = 0.5
+    #: RKT703 |error| ceiling — applied only when the measured device
+    #: kind matches the priced kind (real hardware); None disables.
+    error_ceiling: Optional[float] = 3.0
+    serve_budget: Optional[str] = None
+    demo: bool = False
+
+
+@dataclass
+class CalibReport:
+    """Findings + the record the budget gate and BENCH consume."""
+
+    label: str
+    findings: list = field(default_factory=list)
+    record: dict = field(default_factory=dict)
+    summary: Optional[TraceSummary] = None
+    trace_file: Optional[str] = None
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _gpt2_sentinel_parts():
+    """THE calibration sentinel: the tiny gpt2-shaped TransformerLM every
+    audit family compiles (shard_audit's ``_lm_config``), single-device
+    so the capture leg stays cheap enough for every CI run."""
+    from rocket_tpu.analysis.shard_audit import _lm_parts
+
+    return _lm_parts(None)
+
+
+def _fsdp_sentinel_parts():
+    """The fsdp_1x8 sentinel (bucketed async grad reduce-scatter on the
+    fake 8-device mesh): collectives actually EXECUTE on the CPU
+    backend, so the collective category and measured exposed-comm get a
+    live fixture."""
+    from rocket_tpu.analysis.shard_audit import _fsdp_parts
+
+    return _fsdp_parts()
+
+
+def _tiny_serve_calib_parts():
+    from rocket_tpu.analysis.serve_audit import _tiny_serve_parts
+
+    return _tiny_serve_parts()
+
+
+CALIB_TARGETS: dict[str, CalibTarget] = {
+    target.name: target
+    for target in (
+        CalibTarget(
+            name="gpt2_sentinel",
+            kind="train",
+            build=_gpt2_sentinel_parts,
+            mesh_shape={"data": 1},
+        ),
+        CalibTarget(
+            name="fsdp_1x8",
+            kind="train",
+            build=_fsdp_sentinel_parts,
+            mesh_shape={"data": 8},
+        ),
+        CalibTarget(
+            name="serve_decode",
+            kind="serve",
+            build=_tiny_serve_calib_parts,
+            serve_budget="tiny",
+        ),
+    )
+}
+
+#: Where the calibration captures land by default (re-renderable with
+#: ``python -m rocket_tpu.obs prof runs/prof/<target> --target <target>``).
+DEFAULT_TRACE_ROOT = os.path.join("runs", "prof")
+
+
+# -- train-leg capture -------------------------------------------------------
+
+
+def priced_ops_for_target(target: CalibTarget):
+    """Compile the target's step on its fake mesh and price it.
+
+    Returns ``(compiled, ops, priced_record, abs_inputs, findings)``
+    with ``compiled`` None (and the failure as findings) when the AOT
+    compile is rejected. The optimized HLO priced here is the SAME
+    module the capture executes — names join by construction.
+    """
+    from rocket_tpu.analysis.sched_audit import predict_compiled
+    from rocket_tpu.analysis.shard_audit import (
+        _mesh_from_shape,
+        aot_compile_step,
+        resolve_placement,
+    )
+
+    step_fn, variables, batch, rules, donate = target.build()
+    mesh = _mesh_from_shape(dict(target.mesh_shape))
+    if rules is None:
+        def rules(path, leaf):  # replicate everything
+            return None
+    abs_variables, abs_batch, _specs, _placement = resolve_placement(
+        variables, batch, rules=rules, mesh=mesh, label=target.name,
+    )
+    compiled, findings = aot_compile_step(
+        step_fn, abs_variables, abs_batch, mesh=mesh,
+        donate_argnums=donate, label=target.name,
+    )
+    if compiled is None:
+        return None, [], {}, None, findings
+    hlo = compiled.as_text()
+    scheduled, _ideal, priced_record = predict_compiled(
+        hlo, target.device_kind
+    )
+    match = _HLO_MODULE_RE.search(hlo)
+    priced_record = dict(
+        priced_record, module=match.group(1) if match else ""
+    )
+    return compiled, scheduled.ops, priced_record, \
+        (abs_variables, abs_batch), findings
+
+
+def _concrete_zeros(tree):
+    """Committed zero arrays matching the abstract inputs' shardings —
+    step TIME depends on shapes, not values, so zeros calibrate as well
+    as a checkpoint (tokens index row 0, a valid id everywhere)."""
+    return jax.tree.map(
+        lambda leaf: jax.device_put(
+            np.zeros(leaf.shape, leaf.dtype), leaf.sharding
+        ),
+        tree,
+    )
+
+
+def capture_target_trace(
+    target: CalibTarget,
+    compiled,
+    abs_inputs,
+    trace_dir: str,
+) -> Optional[str]:
+    """Run ``warmup`` untraced + ``steps`` traced executions of the
+    compiled step (donated variables fed back each step, exactly as the
+    Looper would) and return the trace file."""
+    abs_variables, abs_batch = abs_inputs
+    variables = _concrete_zeros(abs_variables)
+    batch = _concrete_zeros(abs_batch)
+    for _ in range(target.warmup):
+        out = compiled(variables, batch)
+        variables = out[0]
+    jax.block_until_ready(variables)
+    session = TraceSession(trace_dir)
+    session.start()
+    try:
+        for i in range(target.steps):
+            with jax.profiler.StepTraceAnnotation(
+                target.name, step_num=i
+            ):
+                out = compiled(variables, batch)
+                variables = out[0]
+                # Deliberate per-step sync: every traced step's device
+                # slices must land inside ITS annotation window, or the
+                # per-step attribution would smear across windows.
+                jax.block_until_ready(out)  # rocketlint: disable=RKT103
+    finally:
+        trace_file = session.stop()
+    return trace_file
+
+
+def _run_train_target(target: CalibTarget, trace_dir: str) -> CalibReport:
+    report = CalibReport(label=target.name)
+    compiled, ops, priced_record, abs_inputs, findings = \
+        priced_ops_for_target(target)
+    report.findings.extend(findings)
+    if compiled is None:
+        return report
+    trace_file = capture_target_trace(
+        target, compiled, abs_inputs, trace_dir
+    )
+    if trace_file is None:
+        report.findings.append(Finding(
+            "RKT702", f"<calib:{target.name}>", 0,
+            "reconcile-join-failure: the profiler wrote no trace-event "
+            f"file under {trace_dir} — nothing to measure",
+        ))
+        return report
+    summary = parse_trace(
+        load_trace_events(trace_file), step_name=target.name
+    )
+    if not summary.steps:
+        # Without step windows the headline error is None, which the
+        # budget diff would silently skip — a gate that measures
+        # nothing must FAIL, not pass vacuously.
+        report.findings.append(Finding(
+            "RKT702", f"<calib:{target.name}>", 0,
+            "reconcile-join-failure: the capture holds no "
+            f"{target.name!r} StepTraceAnnotation windows — the "
+            "headline calibration error cannot be measured (annotation "
+            "name drift? device slices outside the host windows?)",
+        ))
+        return report
+    record, _rows = reconcile(
+        summary, ops, priced_record,
+        module=priced_record.get("module") or None,
+        measured_kind=capture_metadata(trace_file).get("device_kind"),
+        label=target.name,
+    )
+    record.update(target=target.name, kind="train")
+    report.record, report.summary = record, summary
+    report.trace_file = trace_file
+    # Message figures scoped to the PRICED module, like the coverage
+    # fraction itself (the trace also holds init/other modules).
+    module_us = summary.modules.get(record["module"], 0.0)
+    report.findings.extend(check_join_coverage(
+        record["join_coverage"], target.join_floor,
+        measured_us=module_us,
+        unjoined_us=record["unjoined_fraction"] * module_us,
+        label=target.name,
+    ))
+    report.findings.extend(check_error_ceiling(
+        record["calib_error"], target.error_ceiling,
+        device_matched=record["device_matched"], label=target.name,
+    ))
+    return report
+
+
+# -- serve leg ---------------------------------------------------------------
+
+
+def _run_serve_target(target: CalibTarget, trace_dir: str) -> CalibReport:
+    """Trace a real tiny engine's decode phase and reconcile the decode
+    module's measured device time per wave against the committed serve
+    budget's predicted ITL (the device-time quantity the roofline
+    prices — host dispatch overhead is deliberately outside it)."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+    from rocket_tpu.serve.api import ServeEngine
+
+    report = CalibReport(label=target.name)
+    committed = budgets_mod.load_budget(
+        budgets_mod.SERVE_DIR, target.serve_budget
+    )
+    if committed is None:
+        report.findings.append(Finding(
+            "RKT701", f"<calib:{target.name}>", 0,
+            f"calibration-drift: no committed serve budget "
+            f"{target.serve_budget!r} to reconcile against — run "
+            "`python -m rocket_tpu.analysis serve --update-budgets`",
+        ))
+        return report
+
+    model, config = target.build()
+    params = jax.jit(model.init)(jax.random.key(0))["params"]
+    engine = ServeEngine(model, params, config)
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    for _ in range(6):
+        engine.submit(
+            rng.integers(0, vocab, size=12).astype(np.int32),
+            max_new_tokens=16,
+        )
+    # Warm untraced: compile both programs and run the early ticks
+    # (prefills AND the first decode waves — the scheduler dispatches
+    # decode the same tick a prefill completes).
+    for _ in range(4):
+        engine.step()
+    # Only dispatches issued INSIDE the trace window count toward the
+    # measured ITL denominator — the warmup waves above left no slices
+    # in the trace, and the engine's counter is cumulative.
+    dispatches_before = engine.engine.decode_dispatches
+    session = TraceSession(trace_dir)
+    session.start()
+    try:
+        engine.drain()
+    finally:
+        trace_file = session.stop()
+    waves = (
+        engine.engine.decode_dispatches - dispatches_before
+    ) * engine.engine.waves_per_dispatch
+    if trace_file is None or waves <= 0:
+        report.findings.append(Finding(
+            "RKT702", f"<calib:{target.name}>", 0,
+            "reconcile-join-failure: no trace file or no decode waves "
+            "captured from the serve engine",
+        ))
+        return report
+
+    summary = parse_trace(load_trace_events(trace_file))
+    decode_modules = [m for m in summary.modules if "decode_wave" in m]
+    decode_us = sum(summary.modules[m] for m in decode_modules)
+    if not decode_modules or decode_us <= 0:
+        report.findings.append(Finding(
+            "RKT702", f"<calib:{target.name}>", 0,
+            "reconcile-join-failure: the captured trace holds no "
+            f"decode-wave module slices (modules: "
+            f"{sorted(summary.modules)})",
+        ))
+        return report
+    measured_itl_us = decode_us / waves
+    predicted_itl_us = float(committed.get("predicted_itl_us") or 0.0)
+    calib_error = (
+        (predicted_itl_us - measured_itl_us) / measured_itl_us
+    )
+    measured_kind = capture_metadata(trace_file).get("device_kind") \
+        or jax.devices()[0].device_kind
+    spec = device_spec(measured_kind)
+    record = {
+        "target": target.name,
+        "kind": "serve",
+        "serve_budget": target.serve_budget,
+        "decode_waves": waves,
+        "measured_itl_us": round(measured_itl_us, 3),
+        "predicted_itl_us": predicted_itl_us,
+        "predicted_ttft_us": committed.get("predicted_ttft_us"),
+        "calib_error": round(calib_error, 4),
+        "abs_calib_error": round(abs(calib_error), 4),
+        "device_kind_measured": measured_kind,
+        "priced_for": committed.get("device_kind"),
+        "device_matched": spec is not None
+        and spec.kind == committed.get("device_kind"),
+    }
+    report.record, report.summary = record, summary
+    report.trace_file = trace_file
+    report.findings.extend(check_error_ceiling(
+        calib_error, target.error_ceiling,
+        device_matched=record["device_matched"], label=target.name,
+    ))
+    return report
+
+
+# -- runner / rendering ------------------------------------------------------
+
+
+def run_calib_target(
+    target: CalibTarget,
+    trace_root: Optional[str] = None,
+) -> CalibReport:
+    """Capture -> parse -> reconcile for one target. Traces land under
+    ``<trace_root>/<target>/`` (default ``runs/prof/``; an unwritable
+    root falls back to a temp dir so the audit still reports)."""
+    root = trace_root or DEFAULT_TRACE_ROOT
+    trace_dir = os.path.join(root, target.name)
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+    except OSError:
+        trace_dir = tempfile.mkdtemp(prefix=f"calib_{target.name}_")
+    if target.kind == "serve":
+        return _run_serve_target(target, trace_dir)
+    return _run_train_target(target, trace_dir)
+
+
+def _fmt(value, spec: str) -> str:
+    """Format a nullable record field — the schema allows null (no
+    annotated steps, a category with zero measured time, an unknown
+    measured peak), and a render must never crash on its own record."""
+    if not isinstance(value, (int, float)):
+        return str(value)
+    return format(value, spec)
+
+
+def render_calib(record: Mapping) -> str:
+    """Human view of one calibration record (the obs prof --target and
+    analysis calib text surfaces share it)."""
+    lines = []
+    if record.get("kind") == "serve":
+        lines.append(
+            f"serve calibration [{record.get('target')}]: measured ITL "
+            f"{_fmt(record.get('measured_itl_us'), '.1f')} us/wave "
+            f"(device time, {record.get('decode_waves')} waves) vs "
+            f"predicted {_fmt(record.get('predicted_itl_us'), '.1f')} us "
+            f"-> error {_fmt(record.get('calib_error'), '+.3f')}"
+        )
+        lines.append(
+            f"  priced for {record.get('priced_for')}, measured on "
+            f"{record.get('device_kind_measured')} "
+            f"(matched={record.get('device_matched')})"
+        )
+        return "\n".join(lines)
+    lines.append(
+        f"calibration [{record.get('target', record.get('module'))}]: "
+        f"measured step {_fmt(record.get('measured_step_us'), '.1f')} us "
+        f"vs predicted {_fmt(record.get('predicted_step_us'), '.1f')} us "
+        f"-> error {_fmt(record.get('calib_error'), '+.3f')} "
+        f"(join coverage {_fmt(record.get('join_coverage'), '.1%')}, "
+        f"{record.get('n_steps')} steps)"
+    )
+    lines.append(
+        f"  exposed comm: measured "
+        f"{_fmt(record.get('measured_exposed_comm_us'), '.1f')} us vs "
+        f"predicted {_fmt(record.get('predicted_exposed_comm_us'), '.1f')} "
+        f"us; measured MFU {record.get('measured_mfu')} "
+        f"(predicted {record.get('predicted_mfu')}); priced for "
+        f"{record.get('priced_for')}, measured on "
+        f"{record.get('device_kind_measured')} "
+        f"(matched={record.get('device_matched')})"
+    )
+    categories = record.get("categories") or {}
+    if categories:
+        lines.append(
+            f"  {'category':<12} {'measured_us':>12} {'predicted_us':>13} "
+            f"{'error':>8}"
+        )
+        for cat, row in categories.items():
+            lines.append(
+                f"  {cat:<12} {row['measured_us']:>12.1f} "
+                f"{row['predicted_us']:>13.1f} "
+                f"{_fmt(row.get('error'), '+.3f'):>8}"
+            )
+    offenders = record.get("top_offenders") or []
+    if offenders:
+        lines.append("  top measured-vs-predicted offenders:")
+        lines.append(
+            f"  {'op':<36} {'cat':<11} {'meas_us':>9} {'pred_us':>9} "
+            f"{'where'}"
+        )
+        for row in offenders:
+            lines.append(
+                f"  {row['name'][:36]:<36} {row['category']:<11} "
+                f"{row['measured_us']:>9.2f} {row['predicted_us']:>9.2f} "
+                f"{row.get('where', '')}"
+            )
+    return "\n".join(lines)

@@ -12,9 +12,17 @@ Two jobs:
   the device's bf16 peak (``utils/perf.py``);
 * **trace capture**: a ``jax.profiler`` trace for steps ``[trace_start,
   trace_start + trace_steps)`` written to ``trace_dir`` (default
-  ``<runtime.project_dir>/traces``), viewable in TensorBoard/Perfetto.
+  ``<runtime.project_dir>/traces``), viewable in TensorBoard/Perfetto
+  and — because every window also writes perfetto trace-event JSON —
+  parseable by ``python -m rocket_tpu.obs prof`` with no TF protos.
   Capturing a few mid-run steps skips compile noise; ``destroy`` closes a
-  still-open trace on early termination.
+  still-open trace on early termination. With no explicit
+  ``trace_start``, the ``ROCKET_TPU_PROF`` env installs the
+  bounded-overhead policy (:class:`rocket_tpu.obs.prof.ProfPolicy`:
+  ``N@M`` = trace N steps every M — off by default), and each closed
+  window is parsed on the host into measured step attribution published
+  as ``obs/prof/*`` registry gauges — a supervised week-long run keeps
+  reporting measured numbers at a fixed, tiny trace duty cycle.
 
 Host-side timing measures the *dispatch* loop; once the chip is saturated
 dispatch converges to true step time (JAX backpressures on the donated
@@ -29,6 +37,7 @@ from typing import Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.obs.prof import ProfPolicy
 
 __all__ = ["Profiler"]
 
@@ -39,6 +48,7 @@ class Profiler(Capsule):
         trace_dir: Optional[str] = None,
         trace_start: Optional[int] = None,
         trace_steps: int = 3,
+        trace_every: int = 0,
         flops_per_step: Optional[float] = None,
         flops_per_sample: Optional[float] = None,
         warmup: int = 2,
@@ -47,13 +57,39 @@ class Profiler(Capsule):
     ) -> None:
         super().__init__(statefull=False, priority=priority, runtime=runtime)
         self._trace_dir = trace_dir
+        if trace_start is None and trace_every > 0:
+            # Periodic capture with no explicit first window: ProfPolicy's
+            # N@M semantics — the first window opens at step trace_every.
+            trace_start = int(trace_every)
+        if trace_start is None:
+            # No explicit window from the caller: the env policy (off by
+            # default) decides. A malformed value raises here, at
+            # construction — a typo'd policy must not run untraced.
+            policy = ProfPolicy.from_env(os.environ.get("ROCKET_TPU_PROF"))
+            if policy is not None:
+                trace_start = policy.start
+                trace_steps = policy.steps
+                trace_every = policy.every
+        if trace_every > 0 and trace_every <= trace_steps:
+            raise ValueError(
+                "Profiler: trace_every must exceed trace_steps (the "
+                "window must close before the next opens)"
+            )
         self._trace_start = trace_start
         self._trace_steps = int(trace_steps)
+        self._trace_every = int(trace_every)
+        # One copy of the open-window semantics: the resolved window is
+        # a ProfPolicy whether it came from the env or explicit args.
+        self._policy = None if trace_start is None else ProfPolicy(
+            steps=self._trace_steps, every=self._trace_every,
+            start=int(trace_start),
+        )
         self._flops_per_step = flops_per_step
         self._flops_per_sample = flops_per_sample
         self._warmup = int(warmup)
         self._iter_idx = 0
         self._tracing = False
+        self._window_open_at = 0
         self._t_last: Optional[float] = None
         self._ema: Optional[float] = None  # smoothed step seconds
         self._peak: Optional[float] = None
@@ -123,18 +159,23 @@ class Profiler(Capsule):
     # -- trace window ----------------------------------------------------------
 
     def _maybe_trace(self) -> None:
-        if self._trace_start is None:
+        if self._policy is None:
             return
-        if not self._tracing and self._iter_idx == self._trace_start:
+        if self._tracing and (
+            (self._iter_idx - self._window_open_at) >= self._trace_steps
+        ):
+            self._stop_trace()
+        if not self._tracing and self._policy.window_start(self._iter_idx):
             import jax
 
             if self._runtime is None or self._runtime.is_main_process:
                 os.makedirs(self._trace_dir, exist_ok=True)
-                jax.profiler.start_trace(self._trace_dir)
+                jax.profiler.start_trace(
+                    self._trace_dir, create_perfetto_trace=True
+                )
                 self._tracing = True
+                self._window_open_at = self._iter_idx
                 self.log_info(f"profiler: tracing to {self._trace_dir}")
-        elif self._tracing and self._iter_idx >= self._trace_start + self._trace_steps:
-            self._stop_trace()
 
     def _stop_trace(self) -> None:
         if self._tracing:
@@ -143,3 +184,29 @@ class Profiler(Capsule):
             jax.profiler.stop_trace()
             self._tracing = False
             self.log_info("profiler: trace complete")
+            self._publish_window()
+
+    def _publish_window(self) -> None:
+        """Parse the just-closed window into measured step attribution
+        and publish it as ``obs/prof/*`` gauges. Host-side, once per
+        window (the bounded-overhead policy bounds how often), and
+        never fatal — a malformed trace must not kill training."""
+        telemetry = getattr(self._runtime, "telemetry", None)
+        if telemetry is None or not telemetry.enabled:
+            return
+        try:
+            from rocket_tpu.obs.prof import (
+                find_trace_file,
+                load_trace_events,
+                parse_trace,
+                prof_record,
+                publish_prof,
+            )
+
+            trace_file = find_trace_file(self._trace_dir)
+            if trace_file is None:
+                return
+            summary = parse_trace(load_trace_events(trace_file))
+            publish_prof(telemetry.registry, prof_record(summary))
+        except Exception as exc:  # noqa: BLE001 — observability only
+            self.log_info(f"profiler: trace parse failed: {exc!r}")

@@ -22,7 +22,13 @@ from rocket_tpu.obs.health import (
     HealthConfig,
     HealthMonitor,
 )
-from rocket_tpu.obs.registry import Counter, Gauge, Histogram, MetricsRegistry
+from rocket_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    estimate_quantiles,
+)
 from rocket_tpu.obs.spans import SpanRecorder, load_chrome_trace
 from rocket_tpu.obs.telemetry import Telemetry
 from rocket_tpu.obs.watchdog import Watchdog
@@ -41,6 +47,7 @@ __all__ = [
     "SpanRecorder",
     "Telemetry",
     "Watchdog",
+    "estimate_quantiles",
     "load_chrome_trace",
     "render_report",
 ]

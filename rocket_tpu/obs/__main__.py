@@ -1,19 +1,30 @@
-"""CLI: ``python -m rocket_tpu.obs <report|blackbox> <path>``.
+"""CLI: ``python -m rocket_tpu.obs <report|blackbox|prof> <path>``.
 
 ``report`` renders a run's telemetry record as the goodput table plus the
-key registry metrics. Given a Chrome-trace span file instead, it
-validates the file and reconstructs per-category inclusive totals from
-the span events. A telemetry.json from a zero-step run renders an
-explicit "no steps recorded" row (never a crash on the degenerate
-record). Given a ``supervisor.json`` (a supervised launch's state file)
-it renders the per-generation table + goodput-under-failures headline;
-a supervisor.json sitting next to the telemetry record is folded into
-the same report.
+key registry metrics (histograms as estimated p50/p90/p99 rows, and a
+measured-step-attribution section when ``obs/prof/*`` gauges are
+present). Given a Chrome-trace span file instead, it validates the file
+and reconstructs per-category inclusive totals from the span events. A
+telemetry.json from a zero-step run renders an explicit "no steps
+recorded" row (never a crash on the degenerate record). Given a
+``supervisor.json`` (a supervised launch's state file) it renders the
+per-generation table + goodput-under-failures headline; a
+supervisor.json sitting next to the telemetry record is folded into the
+same report.
 
 ``blackbox`` renders a flight-recorder forensic bundle
 (``runs/<project>/blackbox/<reason>/``, or its ``blackbox.json``
 directly): the dump reason, last-good step, anomaly timeline, the tail
 of the sentinel history, and whether an emergency checkpoint rode along.
+
+``prof`` renders a captured device trace (a ``jax.profiler`` window's
+``perfetto_trace.json.gz`` / ``*.trace.json.gz``, or the directory a
+capture wrote into) as the measured per-op attribution table
+(:mod:`rocket_tpu.obs.prof`); with ``--target <calib target>`` it ALSO
+compiles that target's priced optimized-HLO DAG and renders the
+measured-vs-predicted reconciliation (per-category signed calibration
+error, top offenders with source attribution) — the interactive face of
+``python -m rocket_tpu.analysis calib``.
 
 Exit contract matches the analysis CLIs: 0 = rendered, 2 = usage/parse
 error.
@@ -29,6 +40,7 @@ import sys
 
 from rocket_tpu.obs.flight import BLACKBOX_FILE
 from rocket_tpu.obs.goodput import CATEGORIES, render_report
+from rocket_tpu.obs.registry import estimate_quantiles
 from rocket_tpu.obs.spans import load_chrome_trace
 
 
@@ -63,10 +75,20 @@ def _report_telemetry(doc: dict) -> str:
             lines.append(f"  {name:<36} {rendered}")
     for name, hist in sorted(metrics.get("histograms", {}).items()):
         mean = hist.get("mean")
+        quantiles = estimate_quantiles(hist)
+        tail = "".join(
+            f" {q}={quantiles[q]:.4g}s" for q in ("p50", "p90", "p99")
+            if q in quantiles
+        )
         lines.append(
             f"  {name:<36} count={hist.get('count', 0)}"
             + (f" mean={mean:.4g}s" if mean is not None else "")
+            + tail
         )
+    prof = _render_prof_gauges(metrics)
+    if prof:
+        lines.append("")
+        lines.append(prof)
     watchdog = doc.get("watchdog", {})
     if watchdog.get("enabled"):
         lines.append(
@@ -78,6 +100,43 @@ def _report_telemetry(doc: dict) -> str:
         lines.append(
             f"spans: {spans.get('events', 0)} events "
             f"({spans.get('dropped', 0)} dropped) in {spans.get('file')}"
+        )
+    return "\n".join(lines)
+
+
+def _render_prof_gauges(metrics: dict) -> str:
+    """The measured-step-attribution section: what the last parsed
+    trace window measured (``obs/prof/*`` gauges the Profiler capsule
+    publishes after each window) — empty string when the run never
+    traced."""
+    gauges = metrics.get("gauges", {})
+    counters = metrics.get("counters", {})
+    prof = {k: v for k, v in gauges.items() if k.startswith("obs/prof/")}
+    if not prof:
+        return ""
+    step = prof.get("obs/prof/measured_step_us")
+    lines = [
+        "measured step attribution (last trace window, obs.prof):",
+        f"  windows parsed: "
+        f"{counters.get('obs/prof/windows_parsed', 0):g}  steps in "
+        f"window: {prof.get('obs/prof/n_steps', 0):g}",
+    ]
+    if step is not None:
+        lines.append(
+            f"  per step: device span {step:g} us (busy "
+            f"{prof.get('obs/prof/device_busy_us', 0):g} us, wall "
+            f"{prof.get('obs/prof/wall_step_us', 0):g} us), exposed "
+            f"comm {prof.get('obs/prof/exposed_comm_us', 0):g} us"
+        )
+    fracs = {
+        k.rsplit("frac_", 1)[-1]: v for k, v in prof.items()
+        if "/frac_" in k
+    }
+    if fracs:
+        lines.append(
+            "  device time: " + "  ".join(
+                f"{cat}={value:.1%}" for cat, value in sorted(fracs.items())
+            )
         )
     return "\n".join(lines)
 
@@ -246,10 +305,96 @@ def _render_blackbox(manifest: dict, bundle_dir: str) -> str:
     return "\n".join(lines)
 
 
+def _prof(args) -> int:
+    """The ``prof`` subcommand: parse a captured device trace; with
+    ``--target``, reconcile it against the calib target's priced DAG."""
+    from rocket_tpu.obs.prof import (
+        find_trace_file,
+        load_trace_events,
+        parse_trace,
+        prof_record,
+        render_prof,
+    )
+
+    trace_file = find_trace_file(args.path)
+    if trace_file is None:
+        print(f"error: no trace-event file under {args.path}",
+              file=sys.stderr)
+        return 2
+    try:
+        events = load_trace_events(trace_file)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    summary = parse_trace(events, step_name=args.step_name)
+    if summary.n_slices == 0:
+        print(f"error: {trace_file} holds no device-stream slices "
+              "(hlo_op/hlo_category events)", file=sys.stderr)
+        return 2
+    record = prof_record(summary, top=args.top)
+    record["trace_file"] = trace_file
+
+    calib_record = None
+    if args.target:
+        # The priced DAG compiles on the same fake backend the analysis
+        # CLIs use — provision it the same way (8 virtual CPU devices
+        # unless the caller already chose a platform).
+        from rocket_tpu.analysis.backend import provision_cpu_backend
+
+        provision_cpu_backend()
+        from rocket_tpu.analysis.calib import (
+            CALIB_TARGETS,
+            priced_ops_for_target,
+            reconcile,
+        )
+
+        target = CALIB_TARGETS.get(args.target)
+        if target is None or target.kind != "train":
+            print(
+                f"error: --target must be a train calib target "
+                f"(one of: "
+                f"{', '.join(n for n, t in sorted(CALIB_TARGETS.items()) if t.kind == 'train')})",
+                file=sys.stderr,
+            )
+            return 2
+        compiled, ops, priced_record, _abs, _findings = \
+            priced_ops_for_target(target)
+        if compiled is None:
+            print(f"error: could not compile calib target {args.target}",
+                  file=sys.stderr)
+            return 2
+        from rocket_tpu.obs.prof import capture_metadata
+
+        calib_record, _rows = reconcile(
+            summary, ops, priced_record,
+            module=priced_record.get("module") or None,
+            # The capture sidecar names the machine that MEASURED —
+            # this (possibly different) rendering host must not claim
+            # its own device kind as the measured one.
+            measured_kind=capture_metadata(trace_file).get("device_kind"),
+            label=target.name, top=args.top,
+        )
+        calib_record["target"] = target.name
+        record["calib"] = calib_record
+
+    if args.format == "json":
+        print(json.dumps(record, indent=1, sort_keys=True))
+        return 0
+    print(f"trace: {trace_file}")
+    print(render_prof(summary, record, top=args.top))
+    if calib_record is not None:
+        from rocket_tpu.analysis.calib import render_calib
+
+        print()
+        print(render_calib(dict(calib_record, kind="train")))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.obs",
-        description="render rocket_tpu telemetry records and black-box bundles",
+        description="render rocket_tpu telemetry records, black-box "
+                    "bundles and device traces",
     )
     sub = parser.add_subparsers(dest="command")
     report = sub.add_parser(
@@ -262,7 +407,32 @@ def main(argv=None) -> int:
     blackbox.add_argument(
         "path", help=f"bundle directory or its {BLACKBOX_FILE}"
     )
+    prof = sub.add_parser(
+        "prof", help="render a captured device trace as measured per-op "
+                     "attribution (optionally joined to a calib "
+                     "target's priced HLO DAG)"
+    )
+    prof.add_argument(
+        "path", help="trace file (perfetto_trace.json.gz / "
+                     "*.trace.json[.gz]) or a capture directory"
+    )
+    prof.add_argument(
+        "--target", default=None,
+        help="reconcile against this rocket_tpu.analysis.calib train "
+             "target's priced DAG (e.g. gpt2_sentinel)",
+    )
+    prof.add_argument(
+        "--step-name", default=None,
+        help="only count StepTraceAnnotation windows with this name "
+             "(default: all annotated steps)",
+    )
+    prof.add_argument("--top", type=int, default=15,
+                      help="rows in the per-op table")
+    prof.add_argument("--format", choices=("text", "json"),
+                      default="text")
     args = parser.parse_args(argv)
+    if args.command == "prof":
+        return _prof(args)
     if args.command not in ("report", "blackbox"):
         parser.print_help()
         return 2

@@ -1,0 +1,654 @@
+"""obs.prof — device-trace capture + the measured half of the roofline loop.
+
+Every static auditor in this repo *predicts* (sched_audit prices the
+optimized HLO, serve_audit prices the decode wave); nothing so far
+*measures* at the same granularity — calibration has been two
+hand-rolled bench legs with known structural drift. This module turns a
+``jax.profiler`` capture into the per-op, per-collective measured costs
+those predictions can be joined against (the join itself lives in
+:mod:`rocket_tpu.analysis.calib`):
+
+* **capture** — :class:`TraceSession` wraps ``jax.profiler``'s
+  start/stop with ``create_perfetto_trace=True`` so every window also
+  lands as gzipped Chrome trace-event JSON (``perfetto_trace.json.gz``)
+  — parseable here with zero TF/proto dependencies. The bounded-overhead
+  policy is :class:`ProfPolicy` (``ROCKET_TPU_PROF`` env: off by
+  default; ``N@M`` = trace N steps every M steps, so a week-long run
+  spends a fixed, tiny fraction of wall-clock inside the tracer); the
+  Profiler capsule drives it for training, the serve engine's
+  ``--trace-steps A:B`` window and ``analysis calib``'s targets drive
+  the same session for serving and calibration.
+* **parse** — :func:`parse_trace` buckets the device-stream slices (the
+  events carrying ``hlo_op``/``hlo_category`` args: TensorCore streams
+  on TPU, the thunk-executor threads on CPU) by HLO op name and
+  ``StepTraceAnnotation`` window into measured per-op durations,
+  compute/memory/collective categories, per-step makespans, and
+  measured exposed communication (collective intervals not overlapped
+  by any compute interval on the device streams).
+* **surface** — ``python -m rocket_tpu.obs prof <trace>`` renders the
+  attribution table (and, with ``--target``, the measured-vs-predicted
+  join); :func:`publish_prof` lands the headline numbers as
+  ``obs/prof/*`` registry gauges so supervised long runs continuously
+  report measured step attribution in telemetry.json.
+
+HLO op names in the trace are the *optimized module's* instruction
+names — the same names :func:`rocket_tpu.analysis.sched_audit.parse_hlo_module`
+prices, which is what makes the reconcile join exact by construction
+(modulo the backend's ``.clone`` thunk suffixes, canonicalized away
+here). docs/observability.md §"Measured vs predicted" has the workflow.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Optional, Tuple
+
+__all__ = [
+    "ProfPolicy",
+    "TraceSession",
+    "capture_metadata",
+    "OpSlice",
+    "MeasuredOp",
+    "StepRecord",
+    "TraceSummary",
+    "find_trace_file",
+    "load_trace_events",
+    "parse_trace",
+    "prof_record",
+    "publish_prof",
+    "render_prof",
+]
+
+#: Collective opcodes (base names; matches sched_audit.COLLECTIVE_KINDS
+#: — duplicated so obs stays import-light, pinned equal by test).
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+})
+
+#: Opcodes whose cost is data movement, not arithmetic — the "memory"
+#: category when no richer signal (``hlo_category``, the priced DAG's
+#: kind) is available.
+_MEMORY_OPS = frozenset({
+    "copy", "transpose", "reshape", "broadcast", "slice", "dynamic-slice",
+    "dynamic-update-slice", "gather", "scatter", "concatenate", "pad",
+    "reverse", "select", "copy-start", "copy-done",
+})
+
+_COMPUTE_OPS = frozenset({
+    "dot", "convolution", "fusion", "custom-call", "cholesky",
+    "triangular-solve", "rng", "sort", "reduce", "reduce-window",
+})
+
+
+# -- capture policy ----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ProfPolicy:
+    """Bounded-overhead trace-window policy (``ROCKET_TPU_PROF``).
+
+    ``steps`` consecutive steps are traced per window; with ``every`` >
+    0 a new window opens each time the step counter crosses another
+    multiple of ``every`` (periodic re-capture for long runs), otherwise
+    exactly one window opens at ``start``. Overhead is bounded by
+    construction: the tracer is live for ``steps / every`` of the run.
+
+    Env grammar (off unless set):
+
+    * ``ROCKET_TPU_PROF=1`` — one window, defaults (3 steps at step 10);
+    * ``ROCKET_TPU_PROF=A:B`` — one window over steps ``[A, B)``;
+    * ``ROCKET_TPU_PROF=N@M`` — N steps every M steps (first window at
+      step M), the long-run policy.
+    """
+
+    steps: int = 3
+    every: int = 0
+    start: int = 10
+
+    @classmethod
+    def from_env(cls, value: Optional[str]) -> Optional["ProfPolicy"]:
+        """Parse the ``ROCKET_TPU_PROF`` grammar; None = tracing off.
+        Raises ``ValueError`` on a malformed value — a typo'd policy
+        must not silently run untraced."""
+        if value is None:
+            return None
+        text = value.strip()
+        if text in ("", "0", "off", "false"):
+            return None
+        if text in ("1", "on", "true"):
+            return cls()
+        if "@" in text:
+            steps_s, _, every_s = text.partition("@")
+            steps, every = int(steps_s), int(every_s)
+            if steps <= 0 or every <= steps:
+                raise ValueError(
+                    f"ROCKET_TPU_PROF={value!r}: N@M needs 0 < N < M"
+                )
+            return cls(steps=steps, every=every, start=every)
+        if ":" in text:
+            try:
+                start, stop = parse_step_window(text)
+            except ValueError as exc:
+                raise ValueError(f"ROCKET_TPU_PROF={value!r}: {exc}")
+            return cls(steps=stop - start, every=0, start=start)
+        raise ValueError(
+            f"ROCKET_TPU_PROF={value!r}: expected '1', 'A:B' or 'N@M'"
+        )
+
+    def window_start(self, step: int) -> bool:
+        """Does a trace window open at ``step``?"""
+        if self.every > 0:
+            return step >= self.start and (step - self.start) % self.every == 0
+        return step == self.start
+
+
+def parse_step_window(text: str) -> Tuple[int, int]:
+    """``"A:B"`` -> (A, B) with 0 <= A < B (the serve CLI's
+    ``--trace-steps`` grammar)."""
+    start_s, sep, stop_s = text.partition(":")
+    if not sep:
+        raise ValueError(f"trace window {text!r}: expected 'A:B'")
+    start, stop = int(start_s), int(stop_s)
+    if start < 0 or stop <= start:
+        raise ValueError(f"trace window {text!r}: needs 0 <= A < B")
+    return start, stop
+
+
+#: Sidecar written next to every capture: which machine MEASURED the
+#: trace — a re-render on a different host must not claim its own
+#: device kind as the measured one.
+CAPTURE_META_FILE = "capture.json"
+
+
+class TraceSession:
+    """One ``jax.profiler`` capture window writing a perfetto trace.
+
+    Thin, reentrancy-guarded wrapper: ``start()`` is a no-op while a
+    window is open (jax supports one global trace), ``stop()`` is a
+    no-op when none is. ``trace_file`` resolves the newest trace-event
+    file after stop, and a :data:`CAPTURE_META_FILE` sidecar records
+    the capturing host's device kind/platform."""
+
+    def __init__(self, trace_dir: str) -> None:
+        self.trace_dir = trace_dir
+        self.active = False
+
+    def start(self) -> bool:
+        if self.active:
+            return False
+        import jax
+
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(
+            self.trace_dir, create_perfetto_trace=True
+        )
+        self.active = True
+        return True
+
+    def stop(self) -> Optional[str]:
+        """Close the window; returns the newest trace file (None when
+        no window was open or the backend wrote none)."""
+        if not self.active:
+            return None
+        import jax
+
+        jax.profiler.stop_trace()
+        self.active = False
+        trace_file = find_trace_file(self.trace_dir)
+        if trace_file is not None:
+            try:
+                with open(os.path.join(self.trace_dir, CAPTURE_META_FILE),
+                          "w", encoding="utf-8") as f:
+                    json.dump({
+                        "device_kind": jax.devices()[0].device_kind,
+                        "platform": jax.default_backend(),
+                        "n_devices": jax.device_count(),
+                    }, f)
+            except Exception:  # noqa: BLE001 — metadata is best-effort
+                pass
+        return trace_file
+
+
+def capture_metadata(path: str) -> dict:
+    """The :data:`CAPTURE_META_FILE` sidecar for a trace file or capture
+    directory, or ``{}`` when absent/corrupt. Trace files land a few
+    directories deep (``plugins/profile/<ts>/``), so the search walks
+    up toward the capture root."""
+    directory = path if os.path.isdir(path) else os.path.dirname(path)
+    for _ in range(4):
+        candidate = os.path.join(directory, CAPTURE_META_FILE)
+        if os.path.isfile(candidate):
+            try:
+                with open(candidate, "r", encoding="utf-8") as f:
+                    meta = json.load(f)
+                return meta if isinstance(meta, dict) else {}
+            except (OSError, ValueError):
+                return {}
+        parent = os.path.dirname(directory)
+        if parent == directory:
+            break
+        directory = parent
+    return {}
+
+
+# -- trace loading -----------------------------------------------------------
+
+
+def find_trace_file(path: str) -> Optional[str]:
+    """Resolve ``path`` to a trace-event file.
+
+    A file path is returned as-is; a directory is searched recursively
+    for ``perfetto_trace.json.gz`` first (the proto-free output this
+    module asks the profiler for), then any ``*.trace.json.gz`` —
+    newest wins, so repeated windows into one dir resolve to the last
+    capture."""
+    if os.path.isfile(path):
+        return path
+    candidates = []
+    for pattern in ("**/perfetto_trace.json.gz", "**/*.trace.json.gz",
+                    "**/*.trace.json"):
+        candidates = glob.glob(os.path.join(path, pattern), recursive=True)
+        if candidates:
+            break
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+def load_trace_events(path: str) -> list:
+    """Load Chrome trace-event JSON (plain or gzipped; object or bare
+    array form) and return the event list. Raises ``ValueError`` on a
+    structurally non-trace file."""
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError, EOFError) as exc:
+        raise ValueError(f"{path}: cannot read trace events: {exc}")
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a trace-event file (no event list)")
+    return events
+
+
+# -- parsing -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OpSlice:
+    """One device-stream slice: an HLO op execution."""
+
+    name: str            # raw event name
+    canon: str           # canonical HLO instruction name (join key)
+    opcode: str          # leading opcode guess ("dot", "all-reduce", ...)
+    category: str        # "compute" | "memory" | "collective" | "other"
+    module: str          # hlo_module ("" when the event carries none)
+    ts_us: float
+    dur_us: float
+    step: Optional[int] = None
+
+
+@dataclass
+class MeasuredOp:
+    """All slices of one HLO instruction, aggregated."""
+
+    name: str
+    opcode: str
+    category: str
+    module: str
+    total_us: float = 0.0
+    count: int = 0
+
+    @property
+    def mean_us(self) -> float:
+        return self.total_us / self.count if self.count else 0.0
+
+
+@dataclass
+class StepRecord:
+    """One annotated step window's device-side accounting."""
+
+    name: str
+    step: int
+    start_us: float
+    end_us: float
+    #: host wall time of the annotation span
+    wall_us: float = 0.0
+    #: first-to-last device activity inside the window (the measured
+    #: analogue of the simulated makespan — includes real stalls/gaps)
+    device_span_us: float = 0.0
+    #: union of device busy intervals (parallel streams counted once)
+    device_busy_us: float = 0.0
+    #: collective time not overlapped by any non-collective device slice
+    exposed_comm_us: float = 0.0
+    categories: dict = field(default_factory=dict)
+
+
+@dataclass
+class TraceSummary:
+    """Everything the reconcile join and the CLI table need."""
+
+    ops: list            # list[MeasuredOp], all modules
+    steps: list          # list[StepRecord], step-annotated windows only
+    modules: dict        # module -> total device us
+    n_slices: int = 0
+    unattributed_us: float = 0.0  # device time outside any step window
+
+    def module_ops(self, module: Optional[str]) -> list:
+        if module is None:
+            return list(self.ops)
+        return [op for op in self.ops if op.module == module]
+
+    @property
+    def device_total_us(self) -> float:
+        return sum(op.total_us for op in self.ops)
+
+    def mean(self, attr: str) -> float:
+        """Mean of a StepRecord field over the attributed steps."""
+        if not self.steps:
+            return 0.0
+        return sum(getattr(s, attr) for s in self.steps) / len(self.steps)
+
+    def category_totals(self, module: Optional[str] = None) -> dict:
+        totals: dict[str, float] = {}
+        for op in self.module_ops(module):
+            totals[op.category] = totals.get(op.category, 0.0) + op.total_us
+        return totals
+
+
+_CLONE_RE = re.compile(r"(\.clone)+$")
+_OPCODE_RE = re.compile(r"^%?([a-zA-Z][\w\-]*?)(?:[._]\d[\w.]*)?$")
+
+
+def canonical_op_name(name: str) -> str:
+    """The trace event name, canonicalized to the optimized module's
+    instruction name: leading ``%`` and the backend's ``.clone`` thunk
+    suffixes stripped — this is the reconcile join key."""
+    return _CLONE_RE.sub("", name.lstrip("%").strip())
+
+
+def opcode_of(name: str) -> str:
+    """Leading-opcode guess from a canonical instruction name
+    (``all-reduce.17`` -> ``all-reduce``, ``dot.5`` -> ``dot``)."""
+    m = _OPCODE_RE.match(name)
+    return m.group(1) if m else name
+
+
+def categorize(opcode: str, hlo_category: Optional[str] = None) -> str:
+    """Map an op to compute/memory/collective/other.
+
+    ``hlo_category`` (TPU traces carry it per op) wins when present;
+    otherwise the opcode decides. The reconcile join later *refines*
+    joined ops with the priced DAG's roofline kind — this mapping is
+    the standalone-parse (and unjoined-op) fallback."""
+    text = (hlo_category or "").lower()
+    if text:
+        if any(c in text for c in COLLECTIVE_OPS) or "permute" in text:
+            return "collective"
+        if any(k in text for k in ("fusion", "convolution", "dot",
+                                   "matmul", "custom", "rng", "sort")):
+            return "compute"
+        if any(k in text for k in ("copy", "transpose", "reshape",
+                                   "slice", "broadcast", "gather",
+                                   "scatter", "concat", "pad", "infeed",
+                                   "outfeed", "data formatting")):
+            return "memory"
+        return "other"
+    if opcode in COLLECTIVE_OPS or opcode.startswith("collective-permute"):
+        return "collective"
+    if opcode in _COMPUTE_OPS:
+        return "compute"
+    if opcode in _MEMORY_OPS:
+        return "memory"
+    return "other"
+
+
+def _union_length(intervals: list) -> float:
+    """Total covered length of (start, end) intervals."""
+    return sum(hi - lo for lo, hi in _merge(intervals))
+
+
+def _merge(intervals: list) -> list:
+    """Sorted, non-overlapping union of (start, end) intervals."""
+    merged: list = []
+    for lo, hi in sorted(intervals):
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def _uncovered(intervals: list, cover: list) -> float:
+    """Length of ``intervals``' union not overlapped by ``cover``'s
+    union (the measured exposed-communication computation)."""
+    merged_cover = _merge(cover)
+    exposed = 0.0
+    for lo, hi in _merge(intervals):
+        covered = 0.0
+        for clo, chi in merged_cover:
+            if chi <= lo:
+                continue
+            if clo >= hi:
+                break
+            covered += min(hi, chi) - max(lo, clo)
+        exposed += (hi - lo) - covered
+    return exposed
+
+
+def _is_device_slice(event: dict) -> bool:
+    args = event.get("args") or {}
+    return "hlo_op" in args or "hlo_category" in args
+
+
+def parse_trace(
+    events: Iterable[Mapping],
+    step_name: Optional[str] = None,
+) -> TraceSummary:
+    """Bucket a trace's device-stream slices by HLO op and step window.
+
+    Device slices are the complete (``ph == "X"``) events carrying
+    ``hlo_op``/``hlo_category`` args — the TensorCore streams on TPU,
+    the thunk-executor threads on CPU. Step windows come from
+    ``jax.profiler.StepTraceAnnotation`` spans (events with a
+    ``step_num`` arg; ``step_name`` filters to one annotation name —
+    e.g. the Looper's tag — when several coexist). A slice belongs to
+    the window containing its midpoint; duplicate ``step_num`` spans
+    (multi-thread, re-entered annotations) merge into one window.
+    """
+    slices: list[OpSlice] = []
+    windows: dict[tuple, list] = {}  # (name, step) -> [start, end]
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        args = event.get("args") or {}
+        ts = float(event.get("ts", 0.0))
+        dur = float(event.get("dur", 0.0))
+        if "step_num" in args and not _is_device_slice(event):
+            name = str(event.get("name", "step"))
+            if step_name is not None and name != step_name:
+                continue
+            try:
+                step = int(args["step_num"])
+            except (TypeError, ValueError):
+                continue
+            window = windows.setdefault((name, step), [ts, ts + dur])
+            window[0] = min(window[0], ts)
+            window[1] = max(window[1], ts + dur)
+            continue
+        if not _is_device_slice(event) or dur <= 0:
+            continue
+        raw = str(args.get("hlo_op") or event.get("name", ""))
+        canon = canonical_op_name(raw)
+        opcode = opcode_of(canon)
+        slices.append(OpSlice(
+            name=raw,
+            canon=canon,
+            opcode=opcode,
+            category=categorize(opcode, args.get("hlo_category")),
+            module=str(args.get("hlo_module") or ""),
+            ts_us=ts,
+            dur_us=dur,
+        ))
+
+    steps = [
+        StepRecord(name=name, step=step, start_us=lo, end_us=hi,
+                   wall_us=hi - lo)
+        for (name, step), (lo, hi) in sorted(
+            windows.items(), key=lambda kv: kv[0][1]
+        )
+    ]
+
+    # Slice -> step attribution by midpoint; per-step device accounting.
+    per_step: dict[int, list] = {i: [] for i in range(len(steps))}
+    unattributed_us = 0.0
+    for s in slices:
+        mid = s.ts_us + s.dur_us / 2
+        hit = None
+        for i, rec in enumerate(steps):
+            if rec.start_us <= mid < rec.end_us:
+                hit = i
+                break
+        if hit is None:
+            unattributed_us += s.dur_us
+        else:
+            per_step[hit].append(s)
+
+    for i, rec in enumerate(steps):
+        group = per_step[i]
+        if not group:
+            continue
+        intervals = [(s.ts_us, s.ts_us + s.dur_us) for s in group]
+        rec.device_span_us = (
+            max(hi for _lo, hi in intervals) - min(lo for lo, _hi in intervals)
+        )
+        rec.device_busy_us = _union_length(intervals)
+        comm = [(s.ts_us, s.ts_us + s.dur_us) for s in group
+                if s.category == "collective"]
+        cover = [(s.ts_us, s.ts_us + s.dur_us) for s in group
+                 if s.category != "collective"]
+        rec.exposed_comm_us = _uncovered(comm, cover) if comm else 0.0
+        for s in group:
+            rec.categories[s.category] = (
+                rec.categories.get(s.category, 0.0) + s.dur_us
+            )
+
+    ops: dict[tuple, MeasuredOp] = {}
+    modules: dict[str, float] = {}
+    for s in slices:
+        key = (s.module, s.canon)
+        op = ops.get(key)
+        if op is None:
+            op = ops[key] = MeasuredOp(
+                name=s.canon, opcode=s.opcode, category=s.category,
+                module=s.module,
+            )
+        op.total_us += s.dur_us
+        op.count += 1
+        modules[s.module] = modules.get(s.module, 0.0) + s.dur_us
+
+    return TraceSummary(
+        ops=sorted(ops.values(), key=lambda o: -o.total_us),
+        steps=steps,
+        modules=modules,
+        n_slices=len(slices),
+        unattributed_us=unattributed_us,
+    )
+
+
+# -- records / gauges / rendering -------------------------------------------
+
+
+def prof_record(summary: TraceSummary, top: int = 10) -> dict:
+    """The flat record the registry gauges, telemetry report and bench
+    consume: per-step means over the attributed windows plus the
+    all-window category split."""
+    n_steps = len(summary.steps)
+    totals = summary.category_totals()
+    device_total = sum(totals.values()) or 1.0
+    record = {
+        "n_steps": n_steps,
+        "n_slices": summary.n_slices,
+        "measured_step_us": round(summary.mean("device_span_us"), 3),
+        "wall_step_us": round(summary.mean("wall_us"), 3),
+        "device_busy_us": round(summary.mean("device_busy_us"), 3),
+        "exposed_comm_us": round(summary.mean("exposed_comm_us"), 3),
+        "categories_us": {k: round(v, 3) for k, v in sorted(totals.items())},
+        "category_fractions": {
+            k: round(v / device_total, 4) for k, v in sorted(totals.items())
+        },
+        "top_ops": [
+            {
+                "name": op.name, "category": op.category,
+                "module": op.module,
+                "total_us": round(op.total_us, 3), "count": op.count,
+            }
+            for op in summary.ops[:top]
+        ],
+    }
+    if n_steps:
+        busy = summary.mean("device_busy_us")
+        span = summary.mean("device_span_us")
+        record["device_busy_frac"] = round(busy / span, 4) if span else 0.0
+    return record
+
+
+def publish_prof(registry, record: Mapping, prefix: str = "obs/prof") -> None:
+    """Land a :func:`prof_record`'s scalars as registry gauges (plus a
+    windows-parsed counter) — the continuous-reporting path for
+    supervised long runs."""
+    for key in ("n_steps", "measured_step_us", "wall_step_us",
+                "device_busy_us", "exposed_comm_us", "device_busy_frac"):
+        value = record.get(key)
+        if isinstance(value, (int, float)):
+            registry.gauge(f"{prefix}/{key}").set(float(value))
+    for cat, frac in (record.get("category_fractions") or {}).items():
+        registry.gauge(f"{prefix}/frac_{cat}").set(float(frac))
+    registry.counter(f"{prefix}/windows_parsed").inc()
+
+
+def render_prof(
+    summary: TraceSummary,
+    record: Optional[Mapping] = None,
+    top: int = 15,
+) -> str:
+    """Human table: per-step attribution headline + top ops."""
+    record = record or prof_record(summary, top=top)
+    lines = [
+        f"device trace: {summary.n_slices} slices, "
+        f"{record['n_steps']} annotated step(s), "
+        f"{len(summary.modules)} module(s)",
+    ]
+    if record["n_steps"]:
+        lines.append(
+            f"per step: wall {record['wall_step_us']:.1f} us, device span "
+            f"{record['measured_step_us']:.1f} us (busy "
+            f"{record['device_busy_us']:.1f} us), exposed comm "
+            f"{record['exposed_comm_us']:.1f} us"
+        )
+    cats = record["categories_us"]
+    if cats:
+        fracs = record["category_fractions"]
+        lines.append("category totals:")
+        for cat in sorted(cats, key=lambda c: -cats[c]):
+            lines.append(
+                f"  {cat:<12} {cats[cat]:>12.1f} us  {fracs[cat]:>7.1%}"
+            )
+    ops = summary.ops[:top]
+    if ops:
+        lines.append(
+            f"{'op':<44} {'category':<11} {'count':>6} {'total_us':>11} "
+            f"{'mean_us':>9}"
+        )
+        for op in ops:
+            lines.append(
+                f"{op.name[:44]:<44} {op.category:<11} {op.count:>6} "
+                f"{op.total_us:>11.1f} {op.mean_us:>9.2f}"
+            )
+    return "\n".join(lines)

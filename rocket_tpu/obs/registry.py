@@ -24,7 +24,8 @@ import math
 import threading
 from typing import Optional
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "estimate_quantiles"]
 
 
 class Counter:
@@ -104,6 +105,51 @@ class Histogram:
             "buckets": {f"le_{self.base * 2 ** k:g}": n
                         for k, n in sorted(self.buckets.items())},
         }
+
+
+def estimate_quantiles(snapshot: dict, qs=(0.5, 0.9, 0.99)) -> dict:
+    """Estimated quantiles from a :meth:`Histogram.snapshot` record.
+
+    Works on the serialized form (telemetry.json), so the report CLI
+    can render p50/p90/p99 without the live instrument. Each pow2
+    bucket ``le_U`` covers ``(U/2, U]``; the quantile interpolates
+    geometrically inside its bucket (the honest assumption for a
+    log-spaced histogram), clamped to the observed min/max when
+    present. Returns ``{"p50": ..., ...}``; empty dict for an empty
+    histogram or a malformed record.
+    """
+    try:
+        count = int(snapshot.get("count") or 0)
+        buckets = snapshot.get("buckets") or {}
+        edges = sorted(
+            (float(name[3:]), int(n))
+            for name, n in buckets.items()
+            if name.startswith("le_")
+        )
+    except (TypeError, ValueError, AttributeError):
+        return {}
+    if count <= 0 or not edges:
+        return {}
+    lo_clamp = snapshot.get("min")
+    hi_clamp = snapshot.get("max")
+    out = {}
+    for q in qs:
+        rank = q * count
+        seen = 0
+        for upper, n in edges:
+            seen += n
+            if seen >= rank:
+                # Geometric interpolation inside the (upper/2, upper]
+                # bucket by the rank's position within it.
+                frac = 1.0 - (seen - rank) / n if n else 1.0
+                value = (upper / 2.0) * (2.0 ** frac)
+                if isinstance(lo_clamp, (int, float)):
+                    value = max(value, float(lo_clamp))
+                if isinstance(hi_clamp, (int, float)):
+                    value = min(value, float(hi_clamp))
+                out[f"p{int(q * 100)}"] = value
+                break
+    return out
 
 
 class MetricsRegistry:

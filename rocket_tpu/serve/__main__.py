@@ -127,6 +127,14 @@ def _run(args) -> int:
         tokenizer=tokenizer,
         telemetry=telemetry,
     )
+    if args.trace_steps:
+        # Windowed device-trace capture over engine ticks — the same
+        # capture path training uses; render the file with
+        # `python -m rocket_tpu.obs prof`.
+        engine.capture_trace(
+            args.trace_steps,
+            args.trace_dir or os.path.join(args.out_dir, "traces"),
+        )
     rids = [
         engine.submit(
             prompt,
@@ -150,6 +158,18 @@ def _run(args) -> int:
             print(piece, end="", flush=True)
         print()
     engine.drain()
+    trace_file = engine.finish_trace()
+    if args.trace_steps:
+        if trace_file:
+            print(
+                f"serve: device trace written to {trace_file} — render "
+                "with `python -m rocket_tpu.obs prof`", file=sys.stderr,
+            )
+        else:
+            print(
+                "serve: --trace-steps window captured no trace (window "
+                "past the last tick?)", file=sys.stderr,
+            )
 
     report = engine.report()
     print(json.dumps({"serve_report": report}, indent=1, sort_keys=True))
@@ -195,6 +215,19 @@ def _report(args) -> int:
     return 0
 
 
+def _trace_window_arg(text: str) -> str:
+    """Validate --trace-steps at PARSE time (exit 2, before the model
+    builds) — a malformed window must not traceback after paying the
+    checkpoint-load cost."""
+    from rocket_tpu.obs.prof import parse_step_window
+
+    try:
+        parse_step_window(text)
+    except ValueError as exc:
+        raise argparse.ArgumentTypeError(str(exc))
+    return text
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m rocket_tpu.serve")
     sub = parser.add_subparsers(dest="cmd")
@@ -227,6 +260,13 @@ def main(argv=None) -> int:
                        help="stream the first N requests to stdout")
         p.add_argument("--stdin", action="store_true",
                        help="read prompts from stdin (one per line)")
+        p.add_argument("--trace-steps", default=None, metavar="A:B",
+                       type=_trace_window_arg,
+                       help="capture a windowed device trace over engine "
+                       "ticks [A, B) through the obs.prof capture path "
+                       "(render with `python -m rocket_tpu.obs prof`)")
+        p.add_argument("--trace-dir", default=None,
+                       help="trace output dir (default <out-dir>/traces)")
         p.add_argument("--out-dir", default=os.path.join("runs", "serve"))
 
     rep = sub.add_parser("report", help="render a serve telemetry.json")

@@ -184,6 +184,13 @@ class ServeEngine:
         self._base_harvest_wait_s = 0.0
         self._base_device_gets = 0
         self._base_dispatches = 0
+        # Windowed device-trace capture (obs.prof): armed by
+        # capture_trace(), driven tick-by-tick inside step().
+        self._trace_window: Optional[tuple] = None
+        self._trace_session = None
+        #: The last closed window's trace-event file (perfetto JSON) —
+        #: render with ``python -m rocket_tpu.obs prof``.
+        self.trace_file: Optional[str] = None
 
     # -- intake ------------------------------------------------------------
 
@@ -233,9 +240,20 @@ class ServeEngine:
         roofline's predicted ITL models. A request's very first batch
         contributes only its TTFT (there is no previous emit to span)."""
         with self._lock:
+            self._trace_poll_locked()
             t0 = time.perf_counter()
             gets_before = self.engine.device_gets
-            events = self.scheduler.tick()
+            if self._trace_session is not None and self._trace_session.active:
+                import jax
+
+                # Step-annotated so the prof parser gets per-tick
+                # windows (measured wave attribution per tick).
+                with jax.profiler.StepTraceAnnotation(
+                    "serve_tick", step_num=self._ticks
+                ):
+                    events = self.scheduler.tick()
+            else:
+                events = self.scheduler.tick()
             self._ticks += 1
             self._occupancy_sum += self.scheduler.active_slots
             now = time.perf_counter()
@@ -303,11 +321,56 @@ class ServeEngine:
             except ValueError:
                 pass
 
+    # -- windowed device-trace capture -------------------------------------
+
+    def capture_trace(self, window, trace_dir: str) -> None:
+        """Arm a windowed device-trace capture over engine ticks.
+
+        ``window`` is ``(start, stop)`` tick indices (or the CLI's
+        ``"A:B"`` string): the ``jax.profiler`` session opens before
+        tick ``start`` and closes before tick ``stop``, each traced
+        tick wrapped in a ``StepTraceAnnotation`` — the same capture
+        path training and ``analysis calib`` use, so
+        ``python -m rocket_tpu.obs prof`` renders the result."""
+        from rocket_tpu.obs.prof import TraceSession, parse_step_window
+
+        if isinstance(window, str):
+            window = parse_step_window(window)
+        start, stop = int(window[0]), int(window[1])
+        if start < 0 or stop <= start:
+            raise ValueError(
+                f"capture_trace: window {window!r} needs 0 <= start < stop"
+            )
+        with self._lock:
+            self._trace_window = (start, stop)
+            self._trace_session = TraceSession(trace_dir)
+
+    def _trace_poll_locked(self) -> None:
+        """Open/close the armed trace window for the tick about to run."""
+        if self._trace_session is None:
+            return
+        start, stop = self._trace_window
+        if self._trace_session.active:
+            if self._ticks >= stop:
+                self.trace_file = self._trace_session.stop()
+        elif start <= self._ticks < stop:
+            self._trace_session.start()
+
+    def finish_trace(self) -> Optional[str]:
+        """Close a still-open capture window (e.g. the engine drained
+        before the window's stop tick); returns the trace file."""
+        with self._lock:
+            if self._trace_session is not None \
+                    and self._trace_session.active:
+                self.trace_file = self._trace_session.stop()
+            return self.trace_file
+
     def drain(self, max_ticks: int = 100_000) -> list[TickEvent]:
         """Step until every submitted request completed."""
         events = []
         for _ in range(max_ticks):
             if self.scheduler.idle:
+                self.finish_trace()
                 return events
             events.extend(self.step())
         raise RuntimeError(f"ServeEngine.drain: not idle after {max_ticks} ticks")

@@ -2,11 +2,12 @@
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
 # tune table gate (checked-in kernel-config legality) + SPMD shard
 # audit (self-gate + budget diff) + precision audit (dtype-flow
-# self-gate + numerics budgets) + schedule audit + serving audit
-# (retrace-surface/latency/HBM self-gate + serving budgets) + obs
-# telemetry smoke + resilience smoke (supervised restart / drain) +
-# the tier-1 test suite (command from ROADMAP.md). Exits non-zero on
-# the first failing stage.
+# self-gate + numerics budgets) + schedule audit + calibration audit
+# (live device-trace capture reconciled against the priced HLO DAG +
+# drift budgets) + serving audit (retrace-surface/latency/HBM
+# self-gate + serving budgets) + obs telemetry smoke + resilience
+# smoke (supervised restart / drain) + the tier-1 test suite (command
+# from ROADMAP.md). Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -60,6 +61,33 @@ if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
 fi
 grep -q "RKT501" /tmp/_badoverlap.txt && grep -q "RKT502" /tmp/_badoverlap.txt || {
     echo "badoverlap demo missing RKT501/RKT502:"; cat /tmp/_badoverlap.txt; exit 1;
+}
+
+echo "== calibration audit (measured-vs-predicted reconcile + drift budgets) =="
+# Captures a live device trace of the canonical steps (gpt2 sentinel,
+# fsdp_1x8, the tiny serve engine's decode), buckets it per HLO op
+# (obs.prof), reconciles against the priced optimized-HLO DAG and fails
+# on RKT70x findings or calibration-error / unjoined-fraction drift
+# over tests/fixtures/budgets/calib/. Tolerance 0.5: the measured side
+# is a live timing, and on this CPU container the error is pinned near
+# 1.0 by the device mismatch — a model or join regression still blows
+# through, run-to-run noise does not.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis calib \
+    --budgets tests/fixtures/budgets/calib --tolerance 0.5
+
+echo "== calibration drift true-positive (seeded-bad drifted budget) =="
+# The drift gate must still FIND things: a committed budget claiming
+# far tighter calibration than this machine can produce (the drifted
+# fixture) must fail with RKT701.
+if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis calib \
+        --target gpt2_sentinel \
+        --budgets tests/fixtures/budgets/calib_drifted \
+        --tolerance 0.5 >/tmp/_calib_drift.txt 2>&1; then
+    echo "drifted calib budget passed the gate - RKT701 is broken"
+    exit 1
+fi
+grep -q "RKT701" /tmp/_calib_drift.txt || {
+    echo "drifted-budget leg missing RKT701:"; cat /tmp/_calib_drift.txt; exit 1;
 }
 
 echo "== serving audit (retrace-surface / latency-roofline / HBM-fit self-gate + serving budgets) =="

@@ -4,12 +4,24 @@ parseable telemetry.json whose goodput categories sum to the run's
 wall-clock (within 5%), a span file Perfetto can load (valid Chrome-trace
 JSON), and obs/* scalars in the tracker stream — all with
 ``Runtime(strict=True)`` active, proving the instrumentation adds no
-host-sync to the step path. Exits non-zero on the first violated
-invariant (wired into scripts/check.sh and CI).
+host-sync to the step path.
+
+The capture->parse->reconcile leg (ISSUE 13): the run's Profiler
+captures a mid-run device-trace window (perfetto trace-event output),
+whose parse must land ``obs/prof/*`` gauges in telemetry.json and whose
+file ``python -m rocket_tpu.obs prof`` must render as a nonempty
+per-op attribution table; then ``python -m rocket_tpu.analysis calib
+--target gpt2_sentinel`` must capture a fresh trace of the gpt2
+sentinel step, reconcile it against the priced optimized-HLO DAG and
+hold the committed calibration budget (exit 0).
+
+Exits non-zero on the first violated invariant (wired into
+scripts/check.sh and CI).
 """
 
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -89,7 +101,10 @@ def main() -> None:
                 [
                     rt.Dataset(data, batch_size=32),
                     module,
-                    rt.Profiler(),
+                    # Mid-run trace window: the capture leg of the
+                    # measure->attribute loop (obs.prof parses it into
+                    # obs/prof/* gauges when the window closes).
+                    rt.Profiler(trace_start=4, trace_steps=3),
                     rt.Tracker(project="smoke", directory=runs_dir),
                 ],
                 tag="train", progress=False,
@@ -156,12 +171,65 @@ def main() -> None:
         check(proc.returncode == 0,
               f"report CLI failed on {path}: {proc.stderr[-300:]}")
 
+    # -- capture -> parse -> reconcile (ISSUE 13) --------------------------
+    # capture: the Profiler's window parsed into obs/prof/* gauges the
+    # moment it closed (continuous measured attribution).
+    check("obs/prof/measured_step_us" in gauges,
+          "no obs/prof/* gauges — the trace window was not parsed")
+    # The window opens/closes INSIDE the boundary waves' step
+    # annotations (the Profiler capsule dispatches mid-wave), so of the
+    # 3-step window the fully-interior annotations record: >= 2.
+    check((gauges.get("obs/prof/n_steps") or 0) >= 2,
+          f"obs/prof/n_steps {gauges.get('obs/prof/n_steps')}: trace "
+          "window captured fewer than 2 annotated steps")
+    report_out = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "report", telemetry_path],
+        capture_output=True, text=True,
+    ).stdout
+    check("measured step attribution" in report_out,
+          "report CLI missing the prof section")
+
+    # parse: the prof CLI renders the captured window as a nonempty
+    # per-op attribution table (exit contract: 0 = rendered).
+    trace_dir = os.path.join(workdir, "traces")
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "prof", trace_dir],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 0,
+          f"obs prof CLI failed on {trace_dir}: {proc.stderr[-300:]}")
+    step_count = re.search(r"(\d+) annotated step\(s\)", proc.stdout)
+    check(step_count is not None and int(step_count.group(1)) > 0,
+          "obs prof saw no annotated steps")
+    table_rows = [
+        line for line in proc.stdout.splitlines()
+        if line.strip() and not line.startswith(("trace:", "device",
+                                                 "per step", "category",
+                                                 " ", "op "))
+    ]
+    check(len(table_rows) > 0, "obs prof attribution table is empty")
+
+    # reconcile: the calib CLI captures a fresh trace of the gpt2
+    # sentinel step, joins it against the priced DAG and holds the
+    # committed budget.
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.analysis", "calib",
+         "--target", "gpt2_sentinel", "--budgets",
+         os.path.join("tests", "fixtures", "budgets", "calib")],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    check(proc.returncode == 0,
+          f"analysis calib gate failed: {proc.stdout[-300:]} "
+          f"{proc.stderr[-300:]}")
+
     print(
         "obs smoke OK: "
         f"goodput step={goodput['fractions']['step']:.1%} "
         f"compile={goodput['fractions']['compile']:.1%}, "
         f"{len(complete)} spans, health sentinels green "
-        f"(last good step {health['last_good_step']}), strict guards green"
+        f"(last good step {health['last_good_step']}), strict guards "
+        "green, capture->parse->reconcile leg green"
     )
 
 

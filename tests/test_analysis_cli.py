@@ -58,12 +58,12 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_six_families():
+def test_list_rules_includes_all_seven_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
     for rule_id in ("RKT101", "RKT108", "RKT109", "RKT201", "RKT301",
                     "RKT306", "RKT401", "RKT406", "RKT501", "RKT506",
-                    "RKT601", "RKT606"):
+                    "RKT601", "RKT606", "RKT701", "RKT703"):
         assert rule_id in proc.stdout
 
 
@@ -74,10 +74,12 @@ def test_audit_registry_covers_every_subcommand():
     flag set and exit-code handling through it."""
     from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
 
-    assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve"}
+    assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve",
+                                      "calib"}
 
 
-@pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve"])
+@pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve",
+                                 "calib"])
 def test_every_audit_subcommand_holds_the_usage_contract(sub):
     assert run_cli(sub, "--target", "nope").returncode == 2
     assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
@@ -264,6 +266,31 @@ def test_sched_badpallas_reports_block_misfits():
     assert proc.returncode == 1
     rules = {f["rule"] for f in json.loads(proc.stdout)}
     assert rules == {"RKT504"}
+
+
+# -- calib form --------------------------------------------------------------
+
+
+def test_calib_list_targets():
+    proc = run_cli("calib", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("gpt2_sentinel", "fsdp_1x8", "serve_decode"):
+        assert name in proc.stdout
+    # Each row names what it calibrates against.
+    assert "priced_for=TPU v5 lite" in proc.stdout
+    assert "budget=serve/tiny" in proc.stdout
+
+
+def test_calib_rules_listed():
+    proc = run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in ("RKT701", "RKT702", "RKT703"):
+        assert rule_id in proc.stdout
+
+
+# (The calib self-gate + drifted-budget true-positive e2e runs in
+# tests/test_prof.py's slow tier and in scripts/check.sh — each run
+# captures a live device trace, too heavy to repeat here.)
 
 
 # -- serve form --------------------------------------------------------------

@@ -247,6 +247,59 @@ def test_serve_audit_summary_missing_budgets_is_none(tmp_path):
     ) is None
 
 
+def test_calib_summary_reads_committed_budgets():
+    """The budget half of the calib record (live=False skips the
+    capture leg): per-target |calibration error| + unjoined fraction
+    from the records the calib CI gate verifies."""
+    out = bench.calib_summary(live=False)
+    assert out is not None
+    assert out["source"] == "tests/fixtures/budgets/calib"
+    for name in ("gpt2_sentinel", "fsdp_1x8", "serve_decode"):
+        assert 0 < out["targets"][name]["abs_calib_error"] <= 1.5
+    # Worst-case headline across targets.
+    assert out["abs_calib_error"] >= out["targets"]["gpt2_sentinel"][
+        "abs_calib_error"
+    ]
+
+
+def test_calib_summary_missing_budgets_is_none(tmp_path):
+    assert bench.calib_summary(str(tmp_path / "nowhere"),
+                               live=False) is None
+
+
+def test_write_detail_carries_calib_record(tmp_path):
+    """BENCH_DETAIL.json carries the measured-vs-predicted record, and a
+    probe-less rerun must not drop a previously-written one."""
+    path = tmp_path / "BENCH_DETAIL.json"
+    calib = {
+        "abs_calib_error": 0.99,
+        "targets": {"gpt2_sentinel": {"abs_calib_error": 0.99,
+                                      "unjoined_fraction": 0.32}},
+        "live": {"gpt2_sentinel": {"measured_step_us": 64000.0,
+                                   "device_matched": False}},
+        "source": "tests/fixtures/budgets/calib",
+    }
+    bench.write_detail({"gpt2": _full_result("gpt2")}, path=str(path),
+                       calib=calib)
+    assert json.loads(path.read_text())["calib"] == calib
+    # Probe-less rerun (calib=None) keeps the committed record.
+    bench.write_detail({"mlp": _full_result("mlp")}, path=str(path))
+    assert json.loads(path.read_text())["calib"] == calib
+
+
+@pytest.mark.slow
+def test_calib_summary_live_leg_captures_and_reconciles():
+    """The live half: a real capture->parse->reconcile of the gpt2
+    sentinel on this host. Slow: one AOT compile + a traced run."""
+    out = bench.calib_summary()
+    assert out is not None and "live" in out
+    live = out["live"]["gpt2_sentinel"]
+    assert live["measured_step_us"] > 0
+    assert live["abs_calib_error"] is not None
+    assert live["priced_for"] == "TPU v5 lite"
+    assert isinstance(live["device_matched"], bool)
+
+
 @pytest.mark.slow
 def test_serve_calibration_ties_prediction_to_measured_record():
     """The calibration leg: feed serve_audit_summary a measured serve

@@ -1,0 +1,709 @@
+"""obs.prof + analysis.calib — device-trace capture, parsing and the
+measured-vs-predicted reconcile join (ISSUE 13).
+
+Three layers, bottom-up:
+
+* parser units over synthetic trace events and the checked-in CPU
+  capture (``tests/fixtures/prof/perfetto_trace.json.gz`` — three
+  ``StepTraceAnnotation("train")`` steps of a tiny jitted matmul step,
+  captured by ``jax.profiler`` with ``create_perfetto_trace=True``):
+  slice bucketing, step-annotation alignment, category mapping,
+  exposed-comm interval math, canonicalization;
+* the reconcile join against a FAKE priced DAG (hand-built
+  ``sched_audit.OpCost`` rows): name join, per-device comparand,
+  category refinement by priced kind, signed error math, coverage, and
+  the RKT701/702/703 gates;
+* the process contract: ``python -m rocket_tpu.obs prof`` on the
+  fixture, the Profiler capsule's ``ROCKET_TPU_PROF`` policy, the serve
+  engine's ``capture_trace`` window validation, and (one live leg) the
+  ``analysis calib`` CLI's capture->parse->reconcile e2e with the
+  committed budgets.
+"""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from rocket_tpu.analysis.rules.calib_rules import (
+    check_error_ceiling,
+    check_join_coverage,
+)
+from rocket_tpu.obs.prof import (
+    COLLECTIVE_OPS,
+    ProfPolicy,
+    canonical_op_name,
+    categorize,
+    find_trace_file,
+    load_trace_events,
+    opcode_of,
+    parse_step_window,
+    parse_trace,
+    prof_record,
+    publish_prof,
+    render_prof,
+)
+from rocket_tpu.obs.registry import MetricsRegistry, estimate_quantiles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_TRACE = os.path.join(
+    REPO, "tests", "fixtures", "prof", "perfetto_trace.json.gz"
+)
+CALIB_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "calib")
+DRIFTED_BUDGETS = os.path.join(
+    REPO, "tests", "fixtures", "budgets", "calib_drifted"
+)
+
+
+# -- capture policy ----------------------------------------------------------
+
+def test_policy_env_grammar():
+    assert ProfPolicy.from_env(None) is None
+    assert ProfPolicy.from_env("0") is None
+    assert ProfPolicy.from_env("") is None
+    assert ProfPolicy.from_env("off") is None
+    assert ProfPolicy.from_env("1") == ProfPolicy()
+    assert ProfPolicy.from_env("5:9") == ProfPolicy(steps=4, every=0,
+                                                    start=5)
+    assert ProfPolicy.from_env("3@200") == ProfPolicy(steps=3, every=200,
+                                                      start=200)
+
+
+@pytest.mark.parametrize("bad", ["junk", "5:5", "3:1", "0@3", "5@3",
+                                 "-1:4"])
+def test_policy_rejects_malformed_values(bad):
+    with pytest.raises(ValueError):
+        ProfPolicy.from_env(bad)
+
+
+def test_policy_window_starts():
+    periodic = ProfPolicy(steps=2, every=100, start=100)
+    assert [s for s in range(401) if periodic.window_start(s)] == [
+        100, 200, 300, 400
+    ]
+    once = ProfPolicy(steps=3, every=0, start=7)
+    assert [s for s in range(50) if once.window_start(s)] == [7]
+
+
+def test_parse_step_window():
+    assert parse_step_window("3:9") == (3, 9)
+    for bad in ("9", "4:4", "5:2", "-1:3"):
+        with pytest.raises(ValueError):
+            parse_step_window(bad)
+
+
+def test_profiler_capsule_installs_env_policy(monkeypatch, tmp_path):
+    import rocket_tpu as rt
+
+    monkeypatch.setenv("ROCKET_TPU_PROF", "2@50")
+    profiler = rt.Profiler(trace_dir=str(tmp_path))
+    assert (profiler._trace_start, profiler._trace_steps,
+            profiler._trace_every) == (50, 2, 50)
+    monkeypatch.setenv("ROCKET_TPU_PROF", "junk")
+    with pytest.raises(ValueError):
+        rt.Profiler(trace_dir=str(tmp_path))
+    # An explicit window wins over the env.
+    monkeypatch.setenv("ROCKET_TPU_PROF", "2@50")
+    explicit = rt.Profiler(trace_dir=str(tmp_path), trace_start=5,
+                           trace_steps=4)
+    assert (explicit._trace_start, explicit._trace_steps,
+            explicit._trace_every) == (5, 4, 0)
+    # trace_every alone is a real periodic request, not a silent no-op:
+    # the first window opens at trace_every (ProfPolicy's N@M shape).
+    monkeypatch.delenv("ROCKET_TPU_PROF")
+    periodic = rt.Profiler(trace_dir=str(tmp_path), trace_steps=2,
+                           trace_every=40)
+    assert (periodic._trace_start, periodic._trace_steps,
+            periodic._trace_every) == (40, 2, 40)
+    with pytest.raises(ValueError):
+        rt.Profiler(trace_dir=str(tmp_path), trace_steps=5,
+                    trace_every=5)
+
+
+def test_profiler_periodic_windows_reopen(monkeypatch, tmp_path, runtime):
+    """The N@M policy must re-trace: window at step M, again at 2M."""
+    import jax
+
+    import rocket_tpu as rt
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d, **kw: calls.append("start"))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append("stop"))
+    profiler = rt.Profiler(trace_dir=str(tmp_path), trace_start=4,
+                           trace_steps=2, trace_every=4, runtime=runtime)
+    profiler.setup()
+    profiler.set()
+    for _ in range(13):
+        profiler.launch(None)
+    assert calls == ["start", "stop", "start", "stop", "start"]
+
+
+def test_provision_backend_measure_mode_respects_platform(monkeypatch):
+    """The calib subcommand MEASURES: its provisioning must not force
+    the CPU default (a real accelerator, when present, is the machine
+    to measure) — only the static audits pin cpu."""
+    import os as _os
+
+    from rocket_tpu.analysis import backend
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    backend.provision_cpu_backend(force_cpu_default=False)
+    assert "JAX_PLATFORMS" not in _os.environ
+    assert "xla_force_host_platform_device_count" in _os.environ["XLA_FLAGS"]
+    backend.provision_cpu_backend(force_cpu_default=True)
+    assert _os.environ["JAX_PLATFORMS"] == "cpu"
+    from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
+
+    assert AUDIT_SUBCOMMANDS["calib"].measures
+    assert not AUDIT_SUBCOMMANDS["sched"].measures
+
+
+def test_trace_session_writes_capture_sidecar(monkeypatch, tmp_path):
+    """stop() records WHICH machine measured (the sidecar); a re-render
+    elsewhere reads it instead of claiming its own device kind."""
+    import jax
+
+    from rocket_tpu.obs.prof import TraceSession, capture_metadata
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d, **kw: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    nested = tmp_path / "plugins" / "profile" / "ts1"
+    nested.mkdir(parents=True)
+    trace = nested / "perfetto_trace.json.gz"
+    with gzip.open(trace, "wt") as f:
+        f.write("[]")
+    session = TraceSession(str(tmp_path))
+    session.start()
+    assert session.stop() == str(trace)
+    # Found from the trace file (walks up to the capture root) and from
+    # the capture dir itself; absent elsewhere.
+    for probe in (str(trace), str(tmp_path)):
+        meta = capture_metadata(probe)
+        assert meta["device_kind"] == jax.devices()[0].device_kind
+        assert meta["n_devices"] >= 1
+    assert capture_metadata(str(tmp_path / "plugins")) != {}
+    assert capture_metadata("/definitely/not/a/capture") == {}
+
+
+# -- canonicalization / categories ------------------------------------------
+
+def test_canonical_names_and_opcodes():
+    assert canonical_op_name("%dot.5") == "dot.5"
+    assert canonical_op_name("tanh.4.clone") == "tanh.4"
+    assert canonical_op_name("fusion.12.clone.clone") == "fusion.12"
+    assert opcode_of("dot.5") == "dot"
+    assert opcode_of("all-reduce.17") == "all-reduce"
+    assert opcode_of("transpose_copy_fusion.3") == "transpose_copy_fusion"
+    assert opcode_of("call") == "call"
+
+
+def test_collective_set_pinned_to_shard_audit():
+    """obs stays import-light by duplicating the collective-kind set —
+    this pin keeps the copies equal."""
+    from rocket_tpu.analysis.shard_audit import COLLECTIVE_KINDS
+
+    assert frozenset(COLLECTIVE_KINDS) == COLLECTIVE_OPS
+
+
+def test_categorize_by_opcode_and_hlo_category():
+    assert categorize("all-reduce") == "collective"
+    assert categorize("dot") == "compute"
+    assert categorize("copy") == "memory"
+    assert categorize("tanh") == "other"
+    # TPU traces carry hlo_category per op — it wins over the opcode.
+    assert categorize("anything", "all-reduce") == "collective"
+    assert categorize("anything", "loop fusion") == "compute"
+    assert categorize("anything", "data formatting") == "memory"
+
+
+# -- synthetic-event parsing -------------------------------------------------
+
+def _dev(name, ts, dur, module="jit_step", category=None, tid=2):
+    args = {"hlo_op": name, "hlo_module": module}
+    if category is not None:
+        args["hlo_category"] = category
+    return {"ph": "X", "pid": 1, "tid": tid, "ts": ts, "dur": dur,
+            "name": name, "args": args}
+
+
+def _step(step, ts, dur, name="train"):
+    return {"ph": "X", "pid": 1, "tid": 1, "ts": ts, "dur": dur,
+            "name": name, "args": {"step_num": str(step)}}
+
+
+def test_parse_buckets_slices_by_step_and_name():
+    events = [
+        _step(0, 0, 100),
+        _step(1, 200, 100),
+        _dev("dot.1", 10, 40),            # step 0
+        _dev("dot.1", 210, 50),           # step 1
+        _dev("all-reduce.2", 260, 20),    # step 1
+        _dev("dot.1", 500, 30),           # outside every window
+    ]
+    summary = parse_trace(events)
+    assert len(summary.steps) == 2
+    assert summary.n_slices == 4
+    assert summary.unattributed_us == 30
+    dot = next(op for op in summary.ops if op.name == "dot.1")
+    assert (dot.count, dot.total_us) == (3, 120)
+    assert summary.steps[0].categories == {"compute": 40}
+    assert summary.steps[1].categories == {"compute": 50, "collective": 20}
+    # Step spans: device activity inside the window.
+    assert summary.steps[0].device_span_us == 40
+    assert summary.steps[1].device_span_us == 70
+    # Duplicate step annotations (other threads) merge, and step_name
+    # filters foreign annotations out.
+    summary2 = parse_trace(
+        events + [_step(1, 150, 200), _step(7, 0, 1000, name="eval")],
+        step_name="train",
+    )
+    assert len(summary2.steps) == 2
+    assert summary2.steps[1].start_us == 150
+
+
+def test_measured_exposed_comm_interval_math():
+    events = [
+        _step(0, 0, 1000),
+        _dev("dot.1", 0, 100),                 # compute covers [0, 100)
+        _dev("all-reduce.1", 50, 100),         # [50,150): 50 exposed
+        _dev("all-reduce.2", 400, 50),         # fully exposed
+        _dev("all-reduce.3", 90, 20),          # nested in compute + ar1
+    ]
+    summary = parse_trace(events)
+    rec = summary.steps[0]
+    # Collective union [50,150)+[400,450) = 150us; compute cover [0,100)
+    # overlaps 50 of it -> exposed 100.
+    assert rec.exposed_comm_us == pytest.approx(100.0)
+    assert rec.device_busy_us == pytest.approx(100 + 50 + 50)
+    assert rec.device_span_us == pytest.approx(450.0)
+
+
+def test_prof_record_and_publish_gauges():
+    events = [
+        _step(0, 0, 200), _dev("dot.1", 10, 100),
+        _dev("all-reduce.1", 120, 40),
+    ]
+    summary = parse_trace(events)
+    record = prof_record(summary)
+    assert record["n_steps"] == 1
+    assert record["measured_step_us"] == pytest.approx(150.0)
+    assert record["exposed_comm_us"] == pytest.approx(40.0)
+    assert record["category_fractions"]["compute"] == pytest.approx(
+        100 / 140, abs=1e-4
+    )
+    registry = MetricsRegistry()
+    publish_prof(registry, record)
+    scalars = registry.scalars()
+    assert scalars["obs/prof/measured_step_us"] == pytest.approx(150.0)
+    assert scalars["obs/prof/frac_collective"] == pytest.approx(
+        40 / 140, abs=1e-4
+    )
+    assert scalars["obs/prof/windows_parsed"] == 1.0
+    assert "dot.1" in render_prof(summary, record)
+
+
+# -- the checked-in CPU capture ---------------------------------------------
+
+def test_fixture_trace_parses_with_steps_and_hlo_ops():
+    assert find_trace_file(FIXTURE_TRACE) == FIXTURE_TRACE
+    assert find_trace_file(os.path.dirname(FIXTURE_TRACE)) == FIXTURE_TRACE
+    summary = parse_trace(load_trace_events(FIXTURE_TRACE),
+                          step_name="train")
+    assert len(summary.steps) == 3
+    assert summary.modules.get("jit_step", 0) > 0
+    names = {op.name for op in summary.ops}
+    assert {"dot.3", "dot.5"} <= names
+    # The backend's .clone thunk suffix canonicalizes away.
+    assert "tanh.4" in names and "tanh.4.clone" not in names
+    assert all(s.device_span_us > 0 for s in summary.steps)
+
+
+def test_load_trace_events_rejects_garbage(tmp_path):
+    bad = tmp_path / "x.json"
+    bad.write_text("{\"notTraceEvents\": 3}")
+    with pytest.raises(ValueError):
+        load_trace_events(str(bad))
+    gz = tmp_path / "y.json.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write("not json")
+    with pytest.raises(ValueError):
+        load_trace_events(str(gz))
+    assert find_trace_file(str(tmp_path / "nothing")) is None
+
+
+# -- histogram quantile estimation ------------------------------------------
+
+def test_estimate_quantiles_from_pow2_buckets():
+    from rocket_tpu.obs.registry import Histogram
+
+    hist = Histogram(base=1e-6)
+    for value in [1e-6] * 50 + [3e-6] * 40 + [100e-6] * 10:
+        hist.observe(value)
+    snap = hist.snapshot()
+    q = estimate_quantiles(snap)
+    assert set(q) == {"p50", "p90", "p99"}
+    # p50 in the first bucket (<=1us), p90 near the 2-4us bucket, p99 in
+    # the tail bucket; clamped to observed extremes.
+    assert q["p50"] <= 2e-6
+    assert 2e-6 <= q["p90"] <= 8e-6
+    assert 8e-6 <= q["p99"] <= 128e-6
+    assert q["p50"] <= q["p90"] <= q["p99"]
+    assert estimate_quantiles({"count": 0, "buckets": {}}) == {}
+    assert estimate_quantiles({}) == {}
+    assert estimate_quantiles({"count": 3, "buckets": "junk"}) == {}
+
+
+# -- reconcile against a fake priced DAG ------------------------------------
+
+def _fake_priced():
+    from rocket_tpu.analysis.sched_audit import OpCost
+
+    def op(name, kind, time_us, where="", opcode=None):
+        return OpCost(
+            name=name, opcode=opcode or name.split(".")[0], kind=kind,
+            time_s=time_us * 1e-6, flops=0.0, hbm_bytes=0, comm_bytes=0,
+            is_comm=kind == "comm", operands=(), where=where,
+        )
+
+    return [
+        op("dot.1", "compute", 50.0, where="matmul layers.py:10"),
+        op("all-reduce.1", "comm", 5.0, where="psum grad.py:20"),
+        op("copy.7", "memory", 2.0),
+        op("fusion.9", "memory", 1.0),              # priced, unmeasured
+        op("tuple.1", "free", 0.0),                 # never joins
+        op("all-reduce.1-done", "comm", 0.0, opcode="all-reduce-done"),
+    ]
+
+
+def _fake_summary():
+    events = [
+        _step(0, 0, 1000), _step(1, 1000, 1000),
+        # dot.1: two steps, 100us each; measured category deliberately
+        # WRONG ("other" would be the parser's guess for a weird name)
+        # to prove the priced kind wins after the join.
+        _dev("dot.1", 10, 100), _dev("dot.1", 1010, 100),
+        _dev("all-reduce.1", 120, 40), _dev("all-reduce.1", 1120, 40),
+        _dev("mystery.3", 300, 10), _dev("mystery.3", 1300, 10),
+    ]
+    return parse_trace(events)
+
+
+def test_reconcile_joins_by_name_with_signed_errors():
+    from rocket_tpu.analysis.calib import reconcile
+
+    record, rows = reconcile(
+        _fake_summary(), _fake_priced(),
+        {"predicted_step_time_us": 70.0, "exposed_comm_us": 5.0,
+         "device_kind": "TPU v5 lite", "flops_per_step": 0.0,
+         "predicted_mfu": 0.1},
+        label="fake",
+    )
+    assert record["n_joined_ops"] == 2
+    joined = {r["name"]: r for r in rows}
+    # Per-execution comparand: 100us per dot execution vs 50 predicted.
+    assert joined["dot.1"]["measured_us"] == pytest.approx(100.0)
+    assert joined["dot.1"]["error"] == pytest.approx(-0.5)
+    assert joined["dot.1"]["category"] == "compute"  # priced kind wins
+    assert joined["dot.1"]["where"] == "matmul layers.py:10"
+    assert joined["all-reduce.1"]["category"] == "collective"
+    # Coverage is time-weighted: (200 + 80) of (200 + 80 + 20).
+    assert record["join_coverage"] == pytest.approx(280 / 300, abs=1e-4)
+    assert record["unjoined_fraction"] == pytest.approx(20 / 300, abs=1e-4)
+    # Headline: measured span (first-to-last device activity, 300us per
+    # step: [10, 310)) vs predicted 70.
+    assert record["measured_step_us"] == pytest.approx(300.0)
+    assert record["calib_error"] == pytest.approx(
+        (70 - 300) / 300, abs=1e-3
+    )
+    assert record["abs_calib_error"] == pytest.approx(
+        abs(record["calib_error"])
+    )
+    # Per-category: predicted totals cover ALL priced ops (fusion.9's
+    # memory us rides in), measured totals all measured ops.
+    assert record["categories"]["memory"]["predicted_us"] == pytest.approx(
+        3.0
+    )
+    assert record["categories"]["collective"]["measured_us"] == \
+        pytest.approx(40.0)
+    assert record["measured_exposed_comm_us"] == pytest.approx(40.0)
+
+
+def test_reconcile_picks_best_module():
+    from rocket_tpu.analysis.calib import reconcile
+
+    events = [
+        _step(0, 0, 1000),
+        _dev("dot.1", 10, 100, module="jit_other"),
+        _dev("dot.1", 200, 30, module="jit_right"),
+        _dev("all-reduce.1", 300, 10, module="jit_right"),
+    ]
+    summary = parse_trace(events)
+    # jit_other holds more dot.1 time, but jit_right covers MORE priced
+    # time... both join dot.1; the picker is time-weighted, so jit_other
+    # (100us joined) wins over jit_right (40us) — pin the explicit
+    # module override instead, the auditor's path.
+    record, rows = reconcile(
+        summary, _fake_priced(),
+        {"predicted_step_time_us": 70.0, "device_kind": "TPU v5 lite"},
+        module="jit_right", label="fake",
+    )
+    assert record["module"] == "jit_right"
+    assert {r["name"] for r in rows} == {"dot.1", "all-reduce.1"}
+
+
+def test_zero_step_capture_fails_the_gate_not_silently(monkeypatch,
+                                                       tmp_path):
+    """A capture with no annotated step windows yields a None headline
+    error, which the budget diff would silently skip — the target must
+    FAIL with RKT702 instead of gating nothing."""
+    from rocket_tpu.analysis import calib
+
+    monkeypatch.setattr(
+        calib, "priced_ops_for_target",
+        lambda t: ("fake-compiled", [], {"module": "jit_x"}, None, []),
+    )
+    monkeypatch.setattr(
+        calib, "capture_target_trace",
+        lambda t, c, a, d: str(tmp_path / "t.json"),
+    )
+    monkeypatch.setattr(calib, "load_trace_events", lambda p: [])
+    report = calib._run_train_target(
+        calib.CALIB_TARGETS["gpt2_sentinel"], str(tmp_path)
+    )
+    assert report.record == {}
+    assert [f.rule for f in report.findings] == ["RKT702"]
+    assert "StepTraceAnnotation" in report.findings[0].message
+
+
+def test_serve_cli_rejects_malformed_trace_window_at_parse_time():
+    """--trace-steps must fail at argparse (exit 2), before the model
+    builds."""
+    from rocket_tpu.serve.__main__ import main as serve_main
+
+    for bad in ("7", "8:3", "x:y"):
+        with pytest.raises(SystemExit) as exc:
+            serve_main(["--requests", "1", "--trace-steps", bad])
+        assert exc.value.code == 2
+
+
+def test_render_calib_survives_nullable_fields():
+    """The record schema allows nulls (no annotated steps, a category
+    with zero measured time, unknown measured peak) — the render must
+    never crash on its own record."""
+    from rocket_tpu.analysis.calib import render_calib
+
+    out = render_calib({
+        "target": "t", "kind": "train", "n_steps": 0,
+        "measured_step_us": 0.0, "predicted_step_us": 10.0,
+        "calib_error": None, "join_coverage": 0.0,
+        "measured_exposed_comm_us": 0.0,
+        "predicted_exposed_comm_us": 1.0,
+        "measured_mfu": None, "predicted_mfu": None,
+        "categories": {"other": {"measured_us": 5.0, "predicted_us": 0.0,
+                                 "error": None}},
+        "top_offenders": [],
+    })
+    assert "calibration [t]" in out and "None" in out
+    out = render_calib({"kind": "serve", "target": "s",
+                        "measured_itl_us": None,
+                        "predicted_itl_us": 1.0, "decode_waves": 0,
+                        "calib_error": None})
+    assert "serve calibration [s]" in out
+
+
+def test_calib_rule_checks():
+    assert check_join_coverage(0.9, 0.5) == []
+    assert check_join_coverage(0.2, 0.0) == []       # disabled
+    findings = check_join_coverage(0.2, 0.5, label="t")
+    assert len(findings) == 1 and findings[0].rule == "RKT702"
+    # Ceiling: only bites on matched hardware.
+    assert check_error_ceiling(-5.0, 3.0, device_matched=False) == []
+    assert check_error_ceiling(-2.0, 3.0, device_matched=True) == []
+    assert check_error_ceiling(None, 3.0, device_matched=True) == []
+    assert check_error_ceiling(-5.0, None, device_matched=True) == []
+    findings = check_error_ceiling(-5.0, 3.0, device_matched=True,
+                                   label="t")
+    assert len(findings) == 1 and findings[0].rule == "RKT703"
+
+
+def test_drifted_budget_fixture_trips_rkt701():
+    """The seeded-bad fixture (a budget claiming far tighter calibration
+    than this container can produce) must make the shared diff loop
+    fire RKT701 — the true-positive CI leg's in-process half."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    committed = budgets_mod.load_budget(DRIFTED_BUDGETS, "gpt2_sentinel")
+    real = budgets_mod.load_budget(CALIB_BUDGETS, "gpt2_sentinel")
+    assert committed is not None and real is not None
+    findings = budgets_mod.diff_budget(
+        "gpt2_sentinel", committed, real,
+        keys=budgets_mod.CALIB_GATED_KEYS, rule="RKT701", family="calib",
+    )
+    assert findings and all(f.rule == "RKT701" for f in findings)
+    assert any("abs_calib_error" in f.message for f in findings)
+    # And the real committed budget against itself is clean.
+    assert budgets_mod.diff_budget(
+        "gpt2_sentinel", real, real,
+        keys=budgets_mod.CALIB_GATED_KEYS, rule="RKT701", family="calib",
+    ) == []
+
+
+def test_calib_budgets_and_targets_stay_bijective():
+    from rocket_tpu.analysis.calib import CALIB_TARGETS
+
+    committed = {
+        os.path.splitext(f)[0]
+        for f in os.listdir(CALIB_BUDGETS) if f.endswith(".json")
+    }
+    assert committed == {
+        name for name, t in CALIB_TARGETS.items() if not t.demo
+    }
+    drifted = {
+        os.path.splitext(f)[0]
+        for f in os.listdir(DRIFTED_BUDGETS) if f.endswith(".json")
+    }
+    assert drifted == committed
+
+
+# -- serve engine capture window --------------------------------------------
+
+def test_serve_capture_trace_validates_window(tmp_path):
+    import jax
+
+    from rocket_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from rocket_tpu.serve.api import ServeConfig, ServeEngine
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=1, num_heads=2,
+        dropout=0.0,
+    ))
+    params = jax.jit(model.init)(jax.random.key(0))["params"]
+    engine = ServeEngine(model, params, ServeConfig(
+        max_slots=2, block_len=16, prefill_chunk=16,
+    ))
+    for bad in ("junk", "5:5", (3, 2)):
+        with pytest.raises(ValueError):
+            engine.capture_trace(bad, str(tmp_path))
+    # Arming without stepping never opens a session; finish_trace is a
+    # safe no-op.
+    engine.capture_trace("0:2", str(tmp_path / "tr"))
+    assert engine.finish_trace() is None
+
+
+def test_report_renders_prof_section_and_quantile_rows(tmp_path):
+    """`obs report` on a telemetry.json with obs/prof gauges and a
+    histogram renders the measured-attribution section and estimated
+    p50/p90/p99 rows."""
+    from rocket_tpu.obs.__main__ import _render_prof_gauges, _report_telemetry
+
+    metrics = {
+        "counters": {"obs/prof/windows_parsed": 2.0},
+        "gauges": {
+            "obs/prof/n_steps": 3.0,
+            "obs/prof/measured_step_us": 1234.5,
+            "obs/prof/device_busy_us": 1000.0,
+            "obs/prof/wall_step_us": 1300.0,
+            "obs/prof/exposed_comm_us": 12.0,
+            "obs/prof/frac_compute": 0.7,
+            "obs/prof/frac_collective": 0.1,
+        },
+        "histograms": {
+            "data/wait_s": {
+                "count": 10, "total": 0.01, "mean": 0.001,
+                "min": 0.0005, "max": 0.004,
+                "buckets": {"le_0.001": 6, "le_0.002": 3, "le_0.004": 1},
+            },
+        },
+    }
+    section = _render_prof_gauges(metrics)
+    assert "measured step attribution" in section
+    assert "compute=70.0%" in section
+    assert _render_prof_gauges({"gauges": {}}) == ""
+    doc = {"goodput": {"total_wall_s": 1.0,
+                       "categories": {"step": 1.0}, "fractions": {}},
+           "metrics": metrics}
+    out = _report_telemetry(doc)
+    assert "p50=" in out and "p99=" in out
+    assert "measured step attribution" in out
+
+
+# -- CLI contracts -----------------------------------------------------------
+
+def run_obs(*args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", *args],
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=timeout,
+    )
+
+
+def test_obs_prof_cli_renders_fixture():
+    proc = run_obs("prof", FIXTURE_TRACE, "--step-name", "train")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "device trace" in proc.stdout
+    assert "dot.3" in proc.stdout          # nonempty attribution table
+    assert "3 annotated step(s)" in proc.stdout
+
+
+def test_obs_prof_cli_json_shape():
+    proc = run_obs("prof", FIXTURE_TRACE, "--format", "json")
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout)
+    for key in ("n_steps", "measured_step_us", "categories_us",
+                "top_ops", "trace_file"):
+        assert key in record
+    assert record["n_steps"] == 3
+
+
+def test_obs_prof_cli_exit_two_on_garbage(tmp_path):
+    assert run_obs("prof", str(tmp_path / "missing")).returncode == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("[]")  # valid JSON, but no device slices
+    assert run_obs("prof", str(bad)).returncode == 2
+    proc = run_obs("prof", FIXTURE_TRACE, "--target", "not_a_target")
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_calib_cli_capture_parse_reconcile_e2e(tmp_path):
+    """The acceptance path: `analysis calib` on the gpt2 sentinel —
+    capture a CPU trace of the compiled step, parse it, reconcile
+    against the priced DAG, hold the committed budget. Then the drifted
+    seeded-bad budget must fail with RKT701, and `obs prof --target`
+    must render the join from the kept trace."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.analysis", "calib",
+         "--target", "gpt2_sentinel", "--budgets",
+         os.path.join("tests", "fixtures", "budgets", "calib")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    drifted = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.analysis", "calib",
+         "--target", "gpt2_sentinel", "--budgets",
+         os.path.join("tests", "fixtures", "budgets", "calib_drifted"),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert drifted.returncode == 1, drifted.stdout + drifted.stderr
+    rules = {f["rule"] for f in json.loads(drifted.stdout)}
+    assert rules == {"RKT701"}
+    proc = run_obs(
+        "prof", os.path.join("runs", "prof", "gpt2_sentinel"),
+        "--target", "gpt2_sentinel", timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "calibration [gpt2_sentinel]" in proc.stdout
+    assert "top measured-vs-predicted offenders" in proc.stdout

@@ -89,7 +89,7 @@ class TraceSpy:
         self.calls = []
         monkeypatch.setattr(
             jax.profiler, "start_trace",
-            lambda d: self.calls.append(("start", d)),
+            lambda d, **kw: self.calls.append(("start", d)),
         )
         monkeypatch.setattr(
             jax.profiler, "stop_trace",
