@@ -891,12 +891,18 @@ def tune_summary(configs_dir=TUNE_CONFIGS_DIR):
     Per tunable kernel: the checked-in table's entries — each carries
     its (device kind, shape bucket, dtype) key and the tuner-measured
     ``speedup``/``tuned_us``/``default_us`` — so tuned-vs-default
-    speedup is tracked per kernel per device kind round-over-round. An
-    empty table (n_entries 0) means the search found no win for that
-    kernel yet and every call runs the hand-picked default.
-    ``device_kind`` is THIS run's device, so the record says whether the
-    measured throughput above could have hit the table at all. Best
-    effort: None on any failure — emission must never die on tuning."""
+    speedup is tracked per kernel per device kind round-over-round,
+    plus ``structural_wins`` (ISSUE 14): every entry whose winning
+    config pins a STRUCTURAL variant (``impl``/``schedule``/
+    ``epilogue``) away from the reference implementation, with the
+    variant name and the measured speedup vs ``impl=reference`` — the
+    generate-and-verify search's soft-spot scoreboard, carried across
+    probe-less runs like the rest of the record. An empty table
+    (n_entries 0) means the search found no win for that kernel yet and
+    every call runs the hand-picked default. ``device_kind`` is THIS
+    run's device, so the record says whether the measured throughput
+    above could have hit the table at all. Best effort: None on any
+    failure — emission must never die on tuning."""
     try:
         from rocket_tpu import tune
 

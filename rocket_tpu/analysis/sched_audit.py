@@ -760,7 +760,15 @@ def _pallas_fact(eqn) -> Optional[PallasFact]:
             itemsize = np.dtype(dtype).itemsize
         except TypeError:
             itemsize = 4
-        vmem += 2 * _numel(dims) * itemsize  # double-buffered pipeline
+        memspace = str(getattr(
+            getattr(bm, "block_aval", None), "memory_space", ""
+        )).lower()
+        if not (memspace.endswith("any") or memspace.endswith("hbm")):
+            # ANY/HBM operands are NOT pipelined into VMEM — the kernel
+            # DMAs the slices it needs (e.g. gather_gmm's token array);
+            # counting their full shape as a double-buffered block would
+            # flag every HBM-resident operand as a VMEM overflow.
+            vmem += 2 * _numel(dims) * itemsize  # double-buffered pipeline
         key = (shape, dtype)
         blocks.append(key)
         if asd is not None:
@@ -1091,6 +1099,70 @@ def _flash_parts():
     return step_fn, variables, batch, None, donate
 
 
+def _fused_kernels_parts():
+    """The structural kernel candidates (ISSUE 14) traced with their
+    pallas variants PINNED — RKT504 prices the fused programs' blocks
+    against the device tile/VMEM budget like any other pallas kernel,
+    independent of the tune tables (which default them off). Shapes are
+    the soft-spot bench geometries: the resnet18 stem epilogue, the
+    charlm block, a bench-slice gather-gmm. compile_hlo=False — the
+    kernels trace on any backend; Mosaic compilation is hardware's."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.ops.fused_block import block_attn_half
+    from rocket_tpu.ops.fused_conv import fused_bn_act
+    from rocket_tpu.ops.gather_gmm import gather_gmm
+
+    d_blk, h_blk, t_blk = 256, 4, 256
+    n_conv, c_conv = 256 * 32 * 32, 64
+    m_gmm, k_gmm, n_gmm, e_gmm = 2048, 768, 3072, 4
+    bf16 = jnp.bfloat16
+    variables = {
+        "params": {
+            "bn_scale": jax.ShapeDtypeStruct((c_conv,), jnp.float32),
+            "bn_bias": jax.ShapeDtypeStruct((c_conv,), jnp.float32),
+            "ln_scale": jax.ShapeDtypeStruct((d_blk,), jnp.float32),
+            "ln_bias": jax.ShapeDtypeStruct((d_blk,), jnp.float32),
+            "wqkv": jax.ShapeDtypeStruct((d_blk, 3 * d_blk), jnp.float32),
+            "bqkv": jax.ShapeDtypeStruct((3 * d_blk,), jnp.float32),
+            "wproj": jax.ShapeDtypeStruct((d_blk, d_blk), jnp.float32),
+            "bproj": jax.ShapeDtypeStruct((d_blk,), jnp.float32),
+            "experts": jax.ShapeDtypeStruct((e_gmm, k_gmm, n_gmm), bf16),
+        },
+        "state": {},
+    }
+    batch = {
+        "x_conv": jax.ShapeDtypeStruct((n_conv, c_conv), bf16),
+        "x_blk": jax.ShapeDtypeStruct((64, t_blk, d_blk), bf16),
+        "x_tok": jax.ShapeDtypeStruct((m_gmm, k_gmm), bf16),
+        "row_ids": jax.ShapeDtypeStruct((m_gmm,), jnp.int32),
+        "group_sizes": jax.ShapeDtypeStruct((e_gmm,), jnp.int32),
+    }
+
+    def step(variables, batch):
+        p = variables["params"]
+        y1, stats = fused_bn_act(
+            batch["x_conv"], p["bn_scale"], p["bn_bias"],
+            act=True, schedule="twopass", block_rows=512,
+        )
+        y2 = block_attn_half(
+            batch["x_blk"], p["ln_scale"], p["ln_bias"], p["wqkv"],
+            p["bqkv"], p["wproj"], p["bproj"],
+            num_heads=h_blk, epilogue="fused", block_b=1,
+        )
+        y3 = gather_gmm(
+            batch["x_tok"], p["experts"], batch["row_ids"],
+            batch["group_sizes"], tile_m=512, tile_n=512,
+        )
+        total = (
+            y1.astype(jnp.float32).sum() + stats.sum()
+            + y2.astype(jnp.float32).sum() + y3.astype(jnp.float32).sum()
+        )
+        return variables, total
+
+    return step, variables, batch, None, ()
+
+
 def _badsched_parts():
     """Seeded-bad step for the true-positive fixture tests: a big
     all-gather whose result is consumed only at the end while an
@@ -1303,6 +1375,12 @@ def _register_targets():
             name="tp_flash",
             mesh_shape={"data": 1, "model": 8},
             build=_flash_parts,
+            compile_hlo=False,
+        ),
+        SchedTarget(
+            name="fused_kernels",
+            mesh_shape={"data": 1},
+            build=_fused_kernels_parts,
             compile_hlo=False,
         ),
         SchedTarget(

@@ -22,9 +22,17 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101"]
 
 
 class _ConvBN(Layer):
-    def __init__(self, cin, cout, kernel, stride=1, padding="SAME"):
+    """conv -> BN [-> relu]. ``act=True`` folds the relu into the BN
+    epilogue (``nn.layers.BatchNorm.apply_act``) so the ``fused_conv``
+    structural candidate (one pallas stats+normalize+relu program — tune
+    kernel ``fused_conv``) can serve the whole post-conv chain; with no
+    table entry the path is bitwise conv -> BN -> ``jax.nn.relu``."""
+
+    def __init__(self, cin, cout, kernel, stride=1, padding="SAME",
+                 act=False):
         self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding, use_bias=False)
         self.bn = BatchNorm(cout)
+        self.act = act
 
     def init(self, key):
         return {
@@ -38,7 +46,10 @@ class _ConvBN(Layer):
     def apply(self, variables, x, *, mode="train", rng=None):
         p, s = variables["params"], variables["state"]
         x, _ = self.conv.apply({"params": p["conv"], "state": {}}, x)
-        x, bn_state = self.bn.apply({"params": p["bn"], "state": s["bn"]}, x, mode=mode)
+        x, bn_state = self.bn.apply_act(
+            {"params": p["bn"], "state": s["bn"]}, x, mode=mode,
+            act=self.act,
+        )
         return x, {"bn": bn_state}
 
 
@@ -46,7 +57,7 @@ class _BasicBlock(Layer):
     expansion = 1
 
     def __init__(self, cin, width, stride):
-        self.cbr1 = _ConvBN(cin, width, 3, stride=stride)
+        self.cbr1 = _ConvBN(cin, width, 3, stride=stride, act=True)
         self.cbr2 = _ConvBN(width, width, 3)
         self.downsample = (
             _ConvBN(cin, width, 1, stride=stride)
@@ -74,7 +85,6 @@ class _BasicBlock(Layer):
         h, new_state["c1"] = self.cbr1.apply(
             {"params": p["c1"], "state": s["c1"]}, x, mode=mode
         )
-        h = jax.nn.relu(h)
         h, new_state["c2"] = self.cbr2.apply(
             {"params": p["c2"], "state": s["c2"]}, h, mode=mode
         )
@@ -90,8 +100,8 @@ class _Bottleneck(Layer):
 
     def __init__(self, cin, width, stride):
         cout = width * self.expansion
-        self.cbr1 = _ConvBN(cin, width, 1)
-        self.cbr2 = _ConvBN(width, width, 3, stride=stride)
+        self.cbr1 = _ConvBN(cin, width, 1, act=True)
+        self.cbr2 = _ConvBN(width, width, 3, stride=stride, act=True)
         self.cbr3 = _ConvBN(width, cout, 1)
         self.downsample = (
             _ConvBN(cin, cout, 1, stride=stride)
@@ -118,9 +128,7 @@ class _Bottleneck(Layer):
         p, s = variables["params"], variables["state"]
         new_state = {}
         h, new_state["c1"] = self.cbr1.apply({"params": p["c1"], "state": s["c1"]}, x, mode=mode)
-        h = jax.nn.relu(h)
         h, new_state["c2"] = self.cbr2.apply({"params": p["c2"], "state": s["c2"]}, h, mode=mode)
-        h = jax.nn.relu(h)
         h, new_state["c3"] = self.cbr3.apply({"params": p["c3"], "state": s["c3"]}, h, mode=mode)
         if self.downsample is not None:
             x, new_state["down"] = self.downsample.apply(
@@ -150,10 +158,10 @@ class ResNet(Model):
         block_cls = {"basic": _BasicBlock, "bottleneck": _Bottleneck}[block]
         self.stem_kind = stem
         if stem == "imagenet":
-            self.stem = _ConvBN(in_channels, 64, 7, stride=2)
+            self.stem = _ConvBN(in_channels, 64, 7, stride=2, act=True)
             self.pool = nn.MaxPool2D(3, stride=2, padding="SAME")
         else:
-            self.stem = _ConvBN(in_channels, 64, 3, stride=1)
+            self.stem = _ConvBN(in_channels, 64, 3, stride=1, act=True)
             self.pool = None
 
         self.blocks: list[Layer] = []
@@ -191,7 +199,6 @@ class ResNet(Model):
         x, new_state["stem"] = self.stem.apply(
             {"params": p["stem"], "state": s["stem"]}, x, mode=mode
         )
-        x = jax.nn.relu(x)
         if self.pool is not None:
             x, _ = self.pool.apply({"params": {}, "state": {}}, x)
 

@@ -317,6 +317,21 @@ class Block(Layer):
         # GPT-2: residual projections scaled by 1/sqrt(2*num_layers).
         self._resid_scale = (2 * c.num_layers) ** -0.5
         self.layer_idx = layer_idx
+        # Whole-block fusion eligibility (tune kernel "block_attn" —
+        # ISSUE 14): the fused ln1+QKV+attention(+proj) program covers
+        # exactly the LayerNorm / learned-positions / MHA / causal /
+        # biased configuration (the char-LM shape). Anything else stays
+        # on the reference chain statically.
+        self._block_attn_ok = (
+            c.norm == "layernorm"
+            and c.pos_embedding != "rope"
+            and c.causal
+            and (c.num_kv_heads is None or c.num_kv_heads == c.num_heads)
+            and c.attention_impl != "ring"
+            and self.ln1.use_bias
+            and self.attn.qkv.use_bias
+            and self.attn.proj.use_bias
+        )
 
     def init_params(self, key):
         keys = jax.random.split(key, 4)
@@ -359,10 +374,7 @@ class Block(Layer):
             else (None, None, None)
         )
 
-        h, _ = self.ln1.apply({"params": p["ln1"], "state": {}}, x)
-        h, _ = self.attn.apply(
-            {"params": p["attn"], "state": {}}, h, mode=mode, rng=rngs[0]
-        )
+        h = self._attn_half(p, x, mode, rngs[0])
         # Tag for scan_remat_policy="block_io" (save these two, recompute
         # the rest in backward); inert without that policy.
         h = checkpoint_name(h, "attn_out")
@@ -389,6 +401,90 @@ class Block(Layer):
             out_state["frac_dropped"] = aux["frac_dropped"]
             return x + h, out_state
         return x + h, variables["state"]
+
+    def _block_attn_config(self, x):
+        """The ``block_attn`` structural config when the fused
+        whole-block program can serve this call, else None.
+
+        The fused variant engages only when the table (or the
+        ``ROCKET_TPU_BLOCK_ATTN`` force-override, which also runs it
+        interpreted on CPU) pins ``impl="fused"`` — the default is the
+        reference chain, bitwise the pre-seam path. The TP-overlap
+        context and multi-device meshes are excluded: the fusion is the
+        single-chip launch-bound small-model candidate; scale-out keeps
+        the flash shard_map seam."""
+        import os
+
+        if not self._block_attn_ok or x.ndim != 3:
+            return None
+        from rocket_tpu.parallel import collectives as coll
+
+        if coll.current_tp() is not None:
+            return None
+        from rocket_tpu.ops.fused_block import block_attn_supported
+        from rocket_tpu.tune import get_config
+
+        b, t, d = x.shape
+        config = get_config(
+            "block_attn",
+            shape={"b": b, "t": t, "d": d, "h": self.attn.num_heads},
+            dtype=x.dtype,
+        ) or {}
+        forced = os.environ.get("ROCKET_TPU_BLOCK_ATTN")
+        impl = forced or config.get("impl", "reference")
+        if impl != "fused":
+            return None
+        on_cpu = jax.devices()[0].platform == "cpu"
+        if not forced and (on_cpu or jax.device_count() > 1):
+            return None
+        block_b = config.get("block_b", 1)
+        if not block_attn_supported(b, t, d, self.attn.num_heads, block_b):
+            return None
+        return {
+            "epilogue": config.get("epilogue", "fused"),
+            "block_b": block_b,
+            "interpret": True if on_cpu else None,
+        }
+
+    def _attn_half(self, p, x, mode, rng):
+        """ln1 + attention, through either the reference per-op chain
+        (the bitwise default) or the fused whole-block pallas program
+        (``ops/fused_block.py``) when the ``block_attn`` table pins it.
+        Train-mode attention dropout forces ``epilogue="separate"`` —
+        the reference applies dropout BETWEEN the attention core and the
+        output projection, so the fused program stops there and the
+        identical dropout+projection tail runs outside."""
+        cfg = self._block_attn_config(x)
+        if cfg is not None:
+            from rocket_tpu.ops.fused_block import block_attn_half
+
+            attn = self.attn
+            pa = p["attn"]
+            epilogue = cfg["epilogue"]
+            if attn.dropout and mode == "train":
+                epilogue = "separate"
+            out = block_attn_half(
+                x, p["ln1"]["scale"], p["ln1"]["bias"],
+                pa["qkv"]["w"], pa["qkv"]["b"],
+                pa["proj"]["w"], pa["proj"]["b"],
+                num_heads=attn.num_heads, eps=self.ln1.eps,
+                causal=attn.causal, epilogue=epilogue,
+                block_b=cfg["block_b"], interpret=cfg["interpret"],
+            )
+            if epilogue == "separate":
+                b, t, _ = x.shape
+                out = out.reshape(b, t, attn.num_heads, attn.head_dim)
+                out = attn._attn_dropout(out, mode, rng)
+                out = out.reshape(b, t, attn.features)
+                out, _ = attn.proj.apply(
+                    {"params": pa["proj"], "state": {}}, out
+                )
+            return out
+        h, _ = self.ln1.apply({"params": p["ln1"], "state": {}}, x)
+        h, _ = self.attn.apply(
+            {"params": p["attn"], "state": {}}, h, mode=mode, rng=rng
+        )
+        return h
 
     def apply_cached(self, params, x, cache: dict, pos):
         """Decode step: (B, 1, D) through the block with KV-cached attention

@@ -24,6 +24,7 @@ __all__ = [
     "AvgPool2D",
     "GlobalAvgPool2D",
     "BatchNorm",
+    "bn_act_train",
     "LayerNorm",
     "Embedding",
     "Dropout",
@@ -290,6 +291,63 @@ def _bn_train_bwd(eps, moments, res, cts):
 _bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
+def bn_act_train(x, scale, bias, eps, act: bool = False):
+    """Train-mode BN with an optionally FUSED activation — the conv
+    stack's structural seam (ISSUE 14 / ROADMAP item 4).
+
+    Resolves the ``fused_conv`` tune table: ``impl="reference"`` (the
+    default, and the only behavior with absent tables or
+    ``ROCKET_TPU_TUNE=0``) is bitwise the pre-existing path —
+    :func:`_bn_train` followed by ``jax.nn.relu`` when ``act``;
+    ``impl="pallas"`` routes through the fused stats+normalize+relu
+    kernel (``ops/fused_conv.py``) under the table's schedule/block_rows.
+
+    The pallas variant engages on a SINGLE-device accelerator only: the
+    reference path's moment reduction is what GSPMD turns into the
+    cross-replica sync-BN collective under a data-sharded batch, and the
+    fused kernel deliberately has no shard_map seam yet (multi-chip conv
+    is not the flat soft spot). ``ROCKET_TPU_FUSED_CONV`` force-overrides
+    the impl (``pallas`` runs interpreted on CPU — tests and triage).
+    Returns ``(y, stats)`` like ``_bn_train``.
+    """
+    import os
+
+    from rocket_tpu.tune import get_config
+
+    c = x.shape[-1]
+    n = 1
+    for dim in x.shape[:-1]:
+        n *= dim
+    config = get_config(
+        "fused_conv", shape={"n": n, "c": c}, dtype=x.dtype
+    ) or {}
+    forced = os.environ.get("ROCKET_TPU_FUSED_CONV")
+    impl = forced or config.get("impl", "reference")
+    if impl == "pallas":
+        from rocket_tpu.ops.fused_conv import (
+            fused_bn_act,
+            fused_bn_act_supported,
+        )
+
+        block_rows = config.get("block_rows", 512)
+        on_cpu = jax.devices()[0].platform == "cpu"
+        single = jax.device_count() == 1
+        if fused_bn_act_supported(
+            n, block_rows, jnp.dtype(x.dtype).itemsize
+        ) and (bool(forced) or (not on_cpu and single)):
+            return fused_bn_act(
+                x, scale, bias, eps=eps, act=act,
+                schedule=config.get("schedule", "twopass"),
+                block_rows=block_rows,
+                interpret=True if on_cpu else None,
+            )
+    # ONE spelling of the fallback: the same composition the tuner's
+    # parity baseline runs (it wraps this module's _bn_train + relu).
+    from rocket_tpu.ops.fused_conv import reference_bn_act
+
+    return reference_bn_act(x, scale, bias, eps, act)
+
+
 class BatchNorm(Layer):
     """Batch normalization over all but the last (channel) axis.
 
@@ -318,9 +376,20 @@ class BatchNorm(Layer):
         }
 
     def apply(self, variables, x, *, mode="train", rng=None):
+        return self.apply_act(variables, x, mode=mode, act=False)
+
+    def apply_act(self, variables, x, *, mode="train", act=False):
+        """``apply`` with the activation folded into the BN epilogue —
+        the conv-stack call sites (``models/resnet._ConvBN``) route here
+        so the ``fused_conv`` structural candidate can fuse
+        stats+normalize+relu into one program (:func:`bn_act_train`).
+        With ``act=False`` this IS ``apply``; with ``act=True`` and no
+        table entry it is bitwise ``relu(apply(...))``."""
         p, s = variables["params"], variables["state"]
         if mode == "train":
-            y, stats = _bn_train(x, p["scale"], p["bias"], self.eps)
+            y, stats = bn_act_train(
+                x, p["scale"], p["bias"], self.eps, act=act
+            )
             # The EMA is bookkeeping, not a gradient path — stop_gradient
             # makes the fused backward's ignored stats-cotangent provably
             # zero by construction.
@@ -336,7 +405,12 @@ class BatchNorm(Layer):
         mean, var = s["mean"], s["var"]
         inv = jax.lax.rsqrt(var + self.eps) * p["scale"]
         y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
-        return y.astype(x.dtype), s
+        y = y.astype(x.dtype)
+        if act:
+            # Eval stacks are XLA-fused fine; same op order as the
+            # pre-seam external relu.
+            y = jax.nn.relu(y)
+        return y, s
 
     def __repr__(self):
         return f"BatchNorm({self.num_features})"

@@ -31,18 +31,28 @@ from rocket_tpu.nn.module import Layer
 __all__ = ["MoE"]
 
 
-def _gmm_tiling(m: int, k: int, n: int, dtype) -> tuple:
-    """megablox gmm tile triple: the tuned-config table for this device
-    kind / (m, k, n) bucket / dtype (tune kernel ``moe_gmm``), falling
-    back to the hand-picked 512s — the measured sweet spot at bench-MoE
-    shapes (docs/performance.md: 512-wide within ~5% of dense per row,
-    the 128 default ~2x slower). Tiles are clamped to the operand dims
-    either way."""
+def _gmm_config(m: int, k: int, n: int, dtype) -> dict:
+    """The ``moe_gmm`` tuned config for this device kind / (m, k, n)
+    bucket / dtype: the structural ``impl`` axis ('gmm' — explicit
+    gather + megablox, the default — vs 'fused' — in-kernel-routed
+    ``ops/gather_gmm.py``) plus the tile triple, falling back to the
+    hand-picked 512s (docs/performance.md: 512-wide within ~5% of dense
+    per row, the 128 default ~2x slower)."""
     from rocket_tpu.tune import get_config
 
-    config = get_config(
+    config = dict(get_config(
         "moe_gmm", shape={"m": m, "k": k, "n": n}, dtype=dtype
-    ) or {"tile_m": 512, "tile_k": 512, "tile_n": 512}
+    ) or {})
+    config.setdefault("impl", "gmm")
+    config.setdefault("tile_m", 512)
+    config.setdefault("tile_k", 512)
+    config.setdefault("tile_n", 512)
+    return config
+
+
+def _gmm_tiling(m: int, k: int, n: int, dtype) -> tuple:
+    """Clamped megablox tile triple (see :func:`_gmm_config`)."""
+    config = _gmm_config(m, k, n, dtype)
     return (min(config["tile_m"], m), min(config["tile_k"], k),
             min(config["tile_n"], n))
 
@@ -316,21 +326,83 @@ class MoE(Layer):
         sorted_expert = pair_expert[order]
         sorted_token = pair_token[order]
         counts = jnp.bincount(pair_expert, length=e).astype(jnp.int32)
-
-        ex = p["experts"]
-        xs = x_flat[sorted_token]                     # (NK, D)
-        h = _grouped_matmul(xs, ex["w_in"].astype(x.dtype), counts)  # (NK, H)
-        h = jax.nn.gelu(h + ex["b_in"].astype(x.dtype)[sorted_expert])
-        out = _grouped_matmul(h, ex["w_out"].astype(x.dtype), counts)
-        out = out + ex["b_out"].astype(x.dtype)[sorted_expert]       # (NK, D)
-
         gate_sorted = top_gates.reshape(n * k)[order].astype(x.dtype)
+
+        # Structural impl axis (tune kernel ``moe_gmm``, ISSUE 14): the
+        # round-5 dropless loss was the GLUE — the materialized
+        # x[sorted_token] gather ran at random-row bandwidth
+        # (docs/performance.md). impl="fused" routes the in-projection
+        # through ops/gather_gmm.py, which gathers the rows inside the
+        # kernel's own DMA pipeline; impl="gmm" (the default — and the
+        # only behavior with absent tables) is the pre-existing path.
+        out = self._dropless_matmuls(
+            p, x_flat, sorted_token, sorted_expert, counts, x.dtype
+        )
+
         y = (
             jnp.zeros((n, d), x.dtype)
             .at[sorted_token]
             .add(out * gate_sorted[:, None])
         )
         return y.reshape(b, t, d)
+
+    def _dropless_matmuls(self, p, x_flat, sorted_token, sorted_expert,
+                          counts, dtype):
+        """Both expert matmuls over the sorted (token, choice) rows —
+        gather-explicit ('gmm') or gather-in-kernel ('fused') per the
+        ``moe_gmm`` table; ``ROCKET_TPU_MOE_GMM`` force-overrides (the
+        fused kernel runs interpreted on CPU under force)."""
+        import os
+
+        nk = sorted_token.shape[0]
+        d, hidden = p["experts"]["w_in"].shape[1:]
+        config = _gmm_config(nk, d, hidden, dtype)
+        forced = os.environ.get("ROCKET_TPU_MOE_GMM")
+        impl = forced or config["impl"]
+        ex = p["experts"]
+        if impl == "fused":
+            from rocket_tpu.ops.gather_gmm import (
+                gather_gmm,
+                gather_gmm_supported,
+                padded_group_layout,
+            )
+
+            on_cpu = jax.devices()[0].platform == "cpu"
+            tm = min(config["tile_m"], nk)
+            tn = min(config["tile_n"], hidden)
+            if gather_gmm_supported(d, hidden, tn) and (
+                bool(forced) or not on_cpu
+            ):
+                row_ids, gsz, padded_pos, m_pad = padded_group_layout(
+                    counts, sorted_token, tm, nk,
+                    sorted_expert=sorted_expert,
+                )
+                # Per padded-row expert id (bias gathers), scattered
+                # from the ids the sort already produced. Pad rows read
+                # expert 0's bias — inert: their outputs are never
+                # gathered back through padded_pos.
+                pexpert = (
+                    jnp.zeros((m_pad,), jnp.int32)
+                    .at[padded_pos].set(sorted_expert.astype(jnp.int32))
+                )
+                h = gather_gmm(
+                    x_flat, ex["w_in"].astype(dtype), row_ids, gsz,
+                    tile_m=tm, tile_n=tn,
+                    interpret=True if on_cpu else None,
+                )
+                h = jax.nn.gelu(h + ex["b_in"].astype(dtype)[pexpert])
+                # The hidden rows are already contiguous in padded-group
+                # order — the out-projection needs no gather; the padded
+                # groups stay tile-aligned for megablox.
+                out = _grouped_matmul(h, ex["w_out"].astype(dtype), gsz)
+                out = out + ex["b_out"].astype(dtype)[pexpert]
+                return out[padded_pos]                       # (NK, D)
+
+        xs = x_flat[sorted_token]                     # (NK, D)
+        h = _grouped_matmul(xs, ex["w_in"].astype(dtype), counts)  # (NK, H)
+        h = jax.nn.gelu(h + ex["b_in"].astype(dtype)[sorted_expert])
+        out = _grouped_matmul(h, ex["w_out"].astype(dtype), counts)
+        return out + ex["b_out"].astype(dtype)[sorted_expert]      # (NK, D)
 
     def __repr__(self):
         return (

@@ -1,0 +1,278 @@
+"""Whole-block fused attention half — ln1 + QKV + attention (+ epilogue).
+
+The char-LM soft spot is kernel-LAUNCH-bound, not FLOP-bound
+(docs/performance.md "Small-model ceilings are dispatch latency"): at
+d=256/T=256 a block's attention half dispatches ~10 device programs
+(layernorm chain, QKV matmul, bias, head split, scores, mask, softmax,
+weighted sum, merge, output projection) whose per-launch overhead
+dominates their microseconds of work. This kernel is the structural
+candidate the tuner measures against that chain (tune kernel
+``block_attn``): ONE pallas program computes
+
+    ln1(x) -> qkv matmul -> per-head causal softmax attention
+           [-> output projection + bias]                  (the epilogue)
+
+per grid step of ``block_b`` batch rows, with the whole (T, D) sequence
+resident in VMEM — legal precisely because the model is small, which is
+the regime where the chain is launch-bound in the first place. The
+``epilogue`` axis is a structural search dimension: ``"fused"`` folds the
+output projection into the same program (maximum launch reduction);
+``"separate"`` stops at the attention output — the shape train-mode
+attention DROPOUT requires, since the reference applies dropout between
+the attention core and the projection (the call site forces it there).
+
+Numerics mirror the reference composition exactly (f32 layernorm
+statistics, f32 scores/softmax, operand-dtype value matmul with f32
+accumulation); the tuner's fwd+bwd parity gate certifies every shipped
+config against `reference_block_attn` (== `nn/attention` + `LayerNorm`
+op for op).
+
+Backward: the custom VJP recomputes through the REFERENCE composition
+(`jax.vjp` of :func:`reference_block_attn` from the saved inputs) — the
+per-block remat recipe the scan path already uses. Gradients are
+therefore the reference's by construction; the fusion buys the forward
+(and any recomputed forward) its launch count. A hand-fused backward
+kernel is the noted follow-up if the tuner shows the recompute tax
+eating the win.
+
+Single-program scope: the kernel sees the rows it is given. The call
+site (`models/transformer.Block`) keeps multi-device meshes on the
+reference path — the flash shard_map seam is the multi-chip story.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "block_attn_half",
+    "block_attn_supported",
+    "reference_block_attn",
+]
+
+_NEG_INF = -1e30
+
+EPILOGUES = ("fused", "separate")
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform == "cpu"
+
+
+def block_attn_supported(b: int, t: int, d: int, num_heads: int,
+                         block_b: int) -> bool:
+    """Shape gate: batch tiles exactly, heads split the width, and the
+    head dim is lane-minor friendly."""
+    if num_heads <= 0 or d % num_heads:
+        return False
+    return b % block_b == 0 and (d // num_heads) % 8 == 0 and t >= 2
+
+
+def reference_block_attn(x, ln_scale, ln_bias, wqkv, bqkv, wproj, bproj,
+                         *, num_heads: int, eps: float = 1e-5,
+                         causal: bool = True, epilogue: str = "fused"):
+    """The per-op composition the kernel is measured against — the exact
+    math of ``LayerNorm.apply`` + fused-QKV ``MultiHeadAttention`` on the
+    XLA path (`nn/attention.dot_product_attention`), minus dropout
+    (which the call site keeps outside). Also the custom VJP's backward.
+    """
+    b, t, d = x.shape
+    hd = d // num_heads
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xn = (xf - mean) * jax.lax.rsqrt(var + eps) * ln_scale
+    if ln_bias is not None:
+        xn = xn + ln_bias
+    xn = xn.astype(x.dtype)
+    qkv = xn @ wqkv.astype(x.dtype)
+    if bqkv is not None:
+        qkv = qkv + bqkv.astype(x.dtype)
+    hw = num_heads * hd
+    q = jnp.moveaxis(qkv[..., :hw].reshape(b, t, num_heads, hd), 1, 2)
+    k = jnp.moveaxis(
+        qkv[..., hw:2 * hw].reshape(b, t, num_heads, hd), 1, 2
+    )
+    v = jnp.moveaxis(qkv[..., 2 * hw:].reshape(b, t, num_heads, hd), 1, 2)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+    out = jnp.moveaxis(out, 1, 2).reshape(b, t, hw)
+    if epilogue == "separate":
+        return out
+    y = out @ wproj.astype(x.dtype)
+    if bproj is not None:
+        y = y + bproj.astype(x.dtype)
+    return y
+
+
+# -- the kernel --------------------------------------------------------------
+
+
+def _block_kernel(x_ref, ln_ref, wqkv_ref, bqkv_ref, wp_ref, bp_ref,
+                  o_ref, *, block_b, num_heads, hd, eps, causal, scale,
+                  epilogue):
+    """One grid step: ``block_b`` full (T, D) rows through the fused
+    attention half. Heads unroll as a python loop over lane slices of
+    the QKV result — small-model head counts make this cheap."""
+    hw = num_heads * hd
+    for r in range(block_b):
+        xf = x_ref[r].astype(jnp.float32)                    # (T, D)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        xn = (xf - mean) * jax.lax.rsqrt(var + eps) * ln_ref[0, :]
+        xn = (xn + ln_ref[1, :]).astype(o_ref.dtype)
+        qkv = jax.lax.dot_general(
+            xn, wqkv_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype) + bqkv_ref[0, :]               # (T, 3*HW)
+
+        heads = []
+        for j in range(num_heads):
+            q = qkv[:, j * hd:(j + 1) * hd]
+            k = qkv[:, hw + j * hd:hw + (j + 1) * hd]
+            v = qkv[:, 2 * hw + j * hd:2 * hw + (j + 1) * hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                        # (T, T) f32
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = s - jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s)
+            w = p / jnp.sum(p, axis=-1, keepdims=True)
+            heads.append(jax.lax.dot_general(
+                w.astype(o_ref.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+        out = jnp.concatenate(heads, axis=-1).astype(o_ref.dtype)
+        if epilogue == "fused":
+            out = jax.lax.dot_general(
+                out, wp_ref[...], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(o_ref.dtype) + bp_ref[0, :]
+        o_ref[r] = out
+
+
+def _run_block(x, ln, wqkv, bqkv, wproj, bproj, *, num_heads, eps,
+               causal, epilogue, block_b, interpret):
+    b, t, d = x.shape
+    hd = d // num_heads
+    hw = num_heads * hd
+    out_w = d if epilogue == "fused" else hw
+    kernel = functools.partial(
+        _block_kernel, block_b=block_b, num_heads=num_heads, hd=hd,
+        eps=eps, causal=causal, scale=1.0 / math.sqrt(hd),
+        epilogue=epilogue,
+    )
+    const = lambda i: (0, 0)  # noqa: E731 — weights: one block, reused
+    return pl.pallas_call(
+        kernel,
+        grid=(b // block_b,),
+        in_specs=[
+            pl.BlockSpec((block_b, t, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((2, d), const),
+            pl.BlockSpec((d, 3 * hw), const),
+            pl.BlockSpec((1, 3 * hw), const),
+            pl.BlockSpec((hw, d), const),
+            pl.BlockSpec((1, d), const),
+        ],
+        out_specs=pl.BlockSpec((block_b, t, out_w), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, t, out_w), x.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x, ln, wqkv, bqkv, wproj, bproj)
+
+
+def block_attn_half(
+    x,
+    ln_scale,
+    ln_bias,
+    wqkv,
+    bqkv,
+    wproj,
+    bproj,
+    *,
+    num_heads: int,
+    eps: float = 1e-5,
+    causal: bool = True,
+    epilogue: str = "fused",
+    block_b: int = 1,
+    interpret: Optional[bool] = None,
+):
+    """Fused ln1+QKV+attention(+projection) for ``x`` (B, T, D).
+
+    Weights are the layer's own parameter arrays (f32 masters welcome —
+    cast to the compute dtype here, matching ``Dense.apply``): ``wqkv``
+    (D, 3*H*Dh) with its fused [q|k|v] column layout, ``wproj``
+    (H*Dh, D). Biases are required (the GPT-2/char-LM configs carry
+    them; bias-free layers stay on the reference path at the call site).
+    Returns (B, T, D) with ``epilogue="fused"`` or the pre-projection
+    (B, T, H*Dh) attention output with ``"separate"``.
+    """
+    if epilogue not in EPILOGUES:
+        raise ValueError(
+            f"block_attn_half: unknown epilogue {epilogue!r} — the table "
+            f"is ahead of the implementation (expected one of {EPILOGUES})"
+        )
+    b, t, d = x.shape
+    if not block_attn_supported(b, t, d, num_heads, block_b):
+        raise ValueError(
+            f"block_attn_half: unsupported shape B={b} T={t} D={d} "
+            f"H={num_heads} block_b={block_b}"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+
+    # The primal/fwd run the pallas program (operands cast to the
+    # compute dtype the way ``Dense.apply`` would); the backward
+    # recomputes through the reference composition from the ORIGINAL
+    # (master-dtype) inputs, so gradients are exactly the reference
+    # path's — the per-block remat recipe.
+    @jax.custom_vjp
+    def fused(x, ln_s, ln_b, wqkv, bqkv, wproj, bproj):
+        dt = x.dtype
+        ln = jnp.stack([
+            ln_s.astype(jnp.float32), ln_b.astype(jnp.float32)
+        ])                                                   # (2, D)
+        return _run_block(
+            x, ln, wqkv.astype(dt), bqkv.astype(dt).reshape(1, -1),
+            wproj.astype(dt), bproj.astype(dt).reshape(1, -1),
+            num_heads=num_heads, eps=eps, causal=causal,
+            epilogue=epilogue, block_b=block_b, interpret=interpret,
+        )
+
+    def _fwd(x, ln_s, ln_b, wqkv, bqkv, wproj, bproj):
+        y = fused(x, ln_s, ln_b, wqkv, bqkv, wproj, bproj)
+        return y, (x, ln_s, ln_b, wqkv, bqkv, wproj, bproj)
+
+    def _bwd(res, dy):
+        x, ln_s, ln_b, wqkv, bqkv, wproj, bproj = res
+        _, vjp = jax.vjp(
+            lambda *a: reference_block_attn(
+                *a, num_heads=num_heads, eps=eps, causal=causal,
+                epilogue=epilogue,
+            ),
+            x, ln_s, ln_b, wqkv, bqkv, wproj, bproj,
+        )
+        return vjp(dy)
+
+    fused.defvjp(_fwd, _bwd)
+    return fused(x, ln_scale, ln_bias, wqkv, bqkv, wproj, bproj)

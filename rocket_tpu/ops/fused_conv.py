@@ -1,0 +1,305 @@
+"""Fused train-mode BatchNorm + activation — the conv stack's epilogue.
+
+RKT503 fingers the ResNet configs as memory-bound on unfused elementwise
+chains: after every convolution the train step reads the conv output for
+the moment reduction, reads it again to normalize, and (for the
+conv->BN->relu stacks) a third time for the activation — three HBM round
+trips of a >=1 MiB activation whose arithmetic intensity is ~0. XLA fuses
+some of the chain but keeps the reduction separate from the normalize.
+
+This module is the structural candidate the tuner measures against that
+chain (tune kernel ``fused_conv``): one pallas program computes the
+moments AND the normalize+scale+bias+relu epilogue. Two schedules, both
+search axes:
+
+* ``schedule="twopass"``: a 2-phase grid over (block_rows, C) tiles of
+  the flattened activation — phase 0 accumulates sum/sum-of-squares in
+  f32 scratch (persistent across grid steps), the phase boundary
+  finalizes mean/inv, phase 1 re-reads each tile and writes the
+  normalized+activated output. Two reads + one write of x, zero
+  intermediate materializations, ONE kernel launch.
+* ``schedule="stats_xla"``: the moment reduction stays the reference XLA
+  stacked (C, 2) reduction (one read) and the pallas program only fuses
+  normalize+scale+bias+relu (one read + one write) — one extra launch,
+  one fewer in-kernel pass; which wins is the tuner's call.
+
+The backward is the REAL fused BN backward (`nn/layers._bn_train_bwd`'s
+math with the relu mask folded in): one stacked (C, 2) reduction yields
+d_bias, d_scale and dx — no pallas needed there yet (the reduction is
+already a single pass; an in-kernel backward is the noted follow-up).
+
+Numerics match the reference (`nn/layers._bn_train` + ``jax.nn.relu``)
+within f32-accumulation reassociation: the kernel accumulates per-tile
+partial sums sequentially where the reference reduces in one pass. The
+tuner's fwd+bwd parity gate is what certifies each shipped config.
+
+Sharding: the kernel computes moments over the rows IT sees. Under a
+multi-device data-sharded batch the reference path's reduction becomes a
+cross-replica collective (sync BN); a bare ``pallas_call`` has no
+equivalent seam, so the call-site gate (`nn/layers.bn_act_train`) keeps
+multi-device traces on the reference path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "fused_bn_act",
+    "fused_bn_act_supported",
+    "reference_bn_act",
+]
+
+#: Sublane minimum per itemsize — mirrors ``tune.space.sublane_min``.
+_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+SCHEDULES = ("twopass", "stats_xla")
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform == "cpu"
+
+
+def fused_bn_act_supported(n: int, block_rows: int, itemsize: int) -> bool:
+    """Shape gate: the flattened activation must tile exactly (pallas
+    masks nothing here — a ragged tail falls back to the reference)."""
+    sub = _SUBLANE.get(itemsize, 8)
+    return block_rows % sub == 0 and n % block_rows == 0
+
+
+def reference_bn_act(x, scale, bias, eps: float, act: bool):
+    """The pre-existing composition the fused kernel is measured against:
+    ``nn/layers._bn_train`` (stacked moments + fused BN backward) followed
+    by relu. Bitwise THE fallback path — the seam calls the same two ops."""
+    from rocket_tpu.nn.layers import _bn_train
+
+    y, stats = _bn_train(x, scale, bias, eps)
+    if act:
+        y = jax.nn.relu(y)
+    return y, stats
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def _emit(x_ref, y_ref, mi_ref, act):
+    """Shared normalize+activate tail: y = (x - mean) * (inv*scale) +
+    bias, in the reference's association order. ``mi`` rows: mean, inv,
+    inv*scale (pre-folded), bias."""
+    xf = x_ref[...].astype(jnp.float32)
+    y = (xf - mi_ref[0, :]) * mi_ref[2, :] + mi_ref[3, :]
+    if act:
+        y = jnp.maximum(y, 0.0)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _twopass_kernel(x_ref, sc_ref, y_ref, stats_ref, acc_ref, mi_ref, *,
+                    n, eps, act):
+    """Grid (2, nt): phase 0 accumulates (sum, sum x^2) per channel into
+    persistent f32 scratch; the first phase-1 step finalizes mean/inv
+    (inv*scale folded once — scale/bias enter as a (2, C) f32 input) and
+    emits the reference-layout (C, 2) raw-moment stats; every phase-1
+    step then normalizes + activates its tile."""
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    xf = x_ref[...].astype(jnp.float32)
+
+    @pl.when((p == 0) & (i == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p == 0)
+    def _accumulate():
+        acc_ref[0, :] = acc_ref[0, :] + jnp.sum(xf, axis=0)
+        acc_ref[1, :] = acc_ref[1, :] + jnp.sum(xf * xf, axis=0)
+
+    @pl.when((p == 1) & (i == 0))
+    def _finalize():
+        mean = acc_ref[0, :] / n
+        ex2 = acc_ref[1, :] / n
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        mi_ref[0, :] = mean
+        mi_ref[1, :] = inv
+        mi_ref[2, :] = inv * sc_ref[0, :]
+        mi_ref[3, :] = sc_ref[1, :]
+        stats_ref[...] = jnp.stack([mean, ex2], axis=-1)
+
+    @pl.when(p == 1)
+    def _normalize():
+        _emit(x_ref, y_ref, mi_ref, act)
+
+
+def _normalize_kernel(x_ref, mi_ref, y_ref, *, act):
+    """Grid (nt,): stats precomputed outside (stats_xla schedule) —
+    pure fused normalize+scale+bias+activation."""
+    _emit(x_ref, y_ref, mi_ref, act)
+
+
+def _run_twopass(x2, scale, bias, eps, act, block_rows, interpret):
+    n, c = x2.shape
+    nt = n // block_rows
+    sc = jnp.stack([scale, bias]).astype(jnp.float32)      # (2, C)
+
+    def x_map(p, i):
+        return (i, 0)
+
+    def y_map(p, i):
+        # Phase-0 steps park on block 0 (never written); Mosaic only
+        # flushes an output buffer when its block index CHANGES, so the
+        # parked steps cost nothing and every block is flushed exactly
+        # once, after its phase-1 write.
+        return (jnp.where(p == 1, i, 0), 0)
+
+    y, stats = pl.pallas_call(
+        functools.partial(_twopass_kernel, n=float(n), eps=eps, act=act),
+        grid=(2, nt),
+        in_specs=[
+            pl.BlockSpec((block_rows, c), x_map),
+            pl.BlockSpec((2, c), lambda p, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, c), y_map),
+            pl.BlockSpec((c, 2), lambda p, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, c), x2.dtype),
+            jax.ShapeDtypeStruct((c, 2), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, c), jnp.float32),   # sum / sum x^2
+            pltpu.VMEM((4, c), jnp.float32),   # mean / inv / inv*scale / bias
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x2, sc)
+    return y, stats
+
+
+def _run_stats_xla(x2, scale, bias, eps, act, block_rows, interpret):
+    n, c = x2.shape
+    nt = n // block_rows
+    xf32 = x2.astype(jnp.float32)
+    # The reference's exact stacked (C, 2) moment reduction (one read;
+    # under data sharding GSPMD turns it into one collective).
+    stats = jnp.mean(
+        jnp.stack([xf32, jnp.square(xf32)], axis=-1), axis=(0,)
+    )
+    mean = stats[..., 0]
+    var = jnp.maximum(stats[..., 1] - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    mi = jnp.stack([
+        mean, inv, inv * scale.astype(jnp.float32),
+        bias.astype(jnp.float32),
+    ])                                                     # (4, C)
+    y = pl.pallas_call(
+        functools.partial(_normalize_kernel, act=act),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+            pl.BlockSpec((4, c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), x2.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x2, mi)
+    return y, stats
+
+
+# -- custom VJP (the real fused backward) ------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _bn_act(x2, scale, bias, eps, act, schedule, block_rows, interpret):
+    if schedule == "stats_xla":
+        return _run_stats_xla(x2, scale, bias, eps, act, block_rows,
+                              interpret)
+    return _run_twopass(x2, scale, bias, eps, act, block_rows, interpret)
+
+
+def _bn_act_fwd(x2, scale, bias, eps, act, schedule, block_rows, interpret):
+    y, stats = _bn_act(x2, scale, bias, eps, act, schedule, block_rows,
+                       interpret)
+    mean = stats[..., 0]
+    var = jnp.maximum(stats[..., 1] - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    return (y, stats), (x2, scale, bias, mean, inv)
+
+
+def _bn_act_bwd(eps, act, schedule, block_rows, interpret, res, cts):
+    """`nn/layers._bn_train_bwd`'s fused math with the relu mask folded:
+    ONE stacked (C, 2) reduction yields d_bias, d_scale and dx. The
+    stats cotangent is ignored (callers stop_gradient the EMA feed,
+    exactly like the reference)."""
+    dy, _ = cts
+    x2, scale, bias, mean, inv = res
+    n = x2.shape[0]
+    dyf = dy.astype(jnp.float32)
+    xhat = (x2.astype(jnp.float32) - mean) * inv
+    if act:
+        # relu'(pre) with the reference's at-zero convention (grad 0).
+        pre = xhat * scale + bias
+        dyf = jnp.where(pre > 0, dyf, 0.0)
+    sums = jnp.sum(jnp.stack([dyf, dyf * xhat], axis=-1), axis=0)
+    sum_dy = sums[..., 0]
+    sum_dy_xhat = sums[..., 1]
+    dx = (scale * inv) * (dyf - sum_dy / n - xhat * (sum_dy_xhat / n))
+    return dx.astype(x2.dtype), sum_dy_xhat, sum_dy
+
+
+_bn_act.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+def fused_bn_act(
+    x,
+    scale,
+    bias,
+    *,
+    eps: float = 1e-5,
+    act: bool = True,
+    schedule: str = "twopass",
+    block_rows: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Fused train-mode BN(+relu) over the channel-minor activation.
+
+    ``x`` ``(..., C)``; ``scale``/``bias`` ``(C,)`` f32 masters. Returns
+    ``(y, stats)`` with ``stats`` the (C, 2) raw moments (mean, E[x^2])
+    in the reference layout (`nn/layers._bn_train`). The leading dims
+    flatten to N rows which must tile ``block_rows`` exactly
+    (:func:`fused_bn_act_supported` — callers fall back otherwise).
+    """
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"fused_bn_act: unknown schedule {schedule!r} — the table is "
+            f"ahead of the implementation (expected one of {SCHEDULES})"
+        )
+    c = x.shape[-1]
+    n = 1
+    for dim in x.shape[:-1]:
+        n *= dim
+    itemsize = jnp.dtype(x.dtype).itemsize
+    if not fused_bn_act_supported(n, block_rows, itemsize):
+        raise ValueError(
+            f"fused_bn_act: N={n} must tile block_rows={block_rows} "
+            f"(sublane {_SUBLANE.get(itemsize, 8)} for {x.dtype})"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    x2 = x.reshape(n, c)
+    y, stats = _bn_act(
+        x2, scale.astype(jnp.float32), bias.astype(jnp.float32),
+        float(eps), bool(act), schedule, int(block_rows), bool(interpret),
+    )
+    return y.reshape(x.shape), stats

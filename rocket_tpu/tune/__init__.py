@@ -1,27 +1,38 @@
-"""rocket_tpu.tune — search-driven pallas launch-config autotuning.
+"""rocket_tpu.tune — generate-and-verify kernel optimization.
 
-Three pieces (ROADMAP item 2, in the CUDA-L1/AutoKernel lineage of
-search beating hand-picked kernel configs):
+Three pieces (ROADMAP item 4, the CUDA-L1/AutoKernel lineage of search
+beating hand-picked kernels):
 
 * **TuneSpace** (:mod:`~rocket_tpu.tune.space`): the declarative legal
   config set per tunable kernel — flash attention fwd/bwd, decode
-  attention, paged decode, MoE gmm tiling, fused BN — with tile/VMEM/
-  diagonal-alignment legality shared by the tuner and the CI gate.
+  attention, paged decode, MoE gmm, fused BN, the conv-BN-relu epilogue,
+  the whole-block attention half — with tile/VMEM/diagonal-alignment
+  legality shared by the tuner and the CI gate. Axes are launch configs
+  (block/tile sizes) AND **structural** dimensions (``TuneSpace.
+  structural``): implementation variants, fusion boundaries, reduction
+  schedules — candidates that are *different traced kernels*, searched
+  through the same loop.
 * **Table + runtime lookup** (:mod:`~rocket_tpu.tune.table`):
   checked-in JSON tables (``rocket_tpu/tune/configs/*.json``) keyed
   ``(device kind, shape bucket, dtype)`` with longest-prefix device
   matching; :func:`get_config` is what the kernels call at trace time,
   falling back to today's hand-picked defaults when nothing matches —
-  an absent/empty table is behavior-identical to an untuned checkout.
+  an absent/empty table is behavior-identical to an untuned checkout,
+  and every structural default is the pre-existing path. A table entry
+  pinning a variant the space no longer carries is a LOUD gate failure
+  (stale structural winner), never a silent fallback.
 * **Offline tuner** (:mod:`~rocket_tpu.tune.tuner`, CLI
   ``python -m rocket_tpu.tune``): sweeps legal candidates on a real
-  accelerator with compile-excluded timing and a numerical-parity check
-  against the untuned kernel (a faster wrong kernel is a rejected
-  candidate), persisting winners with ``--update-table``.
+  accelerator with compile-excluded timing and a fwd+bwd
+  numerical-parity check against the reference implementation (a faster
+  wrong kernel is a rejected candidate — the property the structural
+  search rests on, CI-proven by the seeded-bad leg of
+  ``scripts/tune_structural_smoke.py``), persisting winners with
+  ``--update-table``.
 
-docs/performance.md ("Autotuned kernels") has the workflow; the CI
-table gate is ``python -m rocket_tpu.tune --check-table`` in
-scripts/check.sh.
+docs/performance.md ("Autotuned kernels" + "Structural kernel search")
+has the workflow and the real-TPU runbook; the CI table gate is
+``python -m rocket_tpu.tune --check`` in scripts/check.sh.
 """
 
 from rocket_tpu.tune.space import TUNE_SPACES, TuneSpace, canonical_dtype

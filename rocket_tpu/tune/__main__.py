@@ -8,10 +8,14 @@ clean, 1 = findings/failure, 2 = usage error.
 * ``--update-table``: additionally persist winning configs into the
   checked-in tables (``rocket_tpu/tune/configs/`` or ``--table-dir``).
   Refused on CPU — interpret-mode timings are meaningless;
-* ``--check-table``: the CI table-staleness gate — schema validation,
-  legality re-verification of every entry against its TuneSpace, and
+* ``--check`` / ``--check-table``: the CI table-staleness gate — schema
+  validation, legality re-verification of every entry against its
+  TuneSpace (including the stale-structural-winner check: an entry
+  pinning an ``impl``/variant that no longer exists fails LOUDLY), and
   unknown-device-kind rejection. Runs anywhere (no accelerator);
-* ``--list``: the case and kernel catalog.
+* ``--list``: the case and kernel catalog, structural axes (variant-
+  valued dimensions whose candidates are different traced kernels)
+  marked with ``*``.
 """
 
 from __future__ import annotations
@@ -29,10 +33,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--list", action="store_true",
                         help="print the kernel/case catalog and exit")
-    parser.add_argument("--check-table", action="store_true",
+    parser.add_argument("--check-table", "--check", action="store_true",
+                        dest="check_table",
                         help="validate the checked-in tables (schema, "
-                             "legality vs TuneSpace, known device kinds) "
-                             "and exit — the CI gate")
+                             "legality vs TuneSpace, stale structural "
+                             "winners, known device kinds) and exit — "
+                             "the CI gate")
     parser.add_argument("--kernel", action="append",
                         help="sweep only these kernels")
     parser.add_argument("--case", action="append",
@@ -72,9 +78,15 @@ def main(argv=None) -> int:
         from rocket_tpu.tune.space import TUNE_SPACES
 
         for name, space in sorted(TUNE_SPACES.items()):
-            axes = ", ".join(f"{k}={list(v)}" for k, v in
-                             sorted(space.axes.items()))
+            axes = ", ".join(
+                f"{k}{'*' if k in space.structural else ''}={list(v)}"
+                for k, v in sorted(space.axes.items())
+            )
             print(f"{name:18s} {axes}")
+            if space.structural:
+                print(f"{'':18s} structural axes (variant-valued — each "
+                      f"candidate is a different traced kernel): "
+                      f"{', '.join(space.structural)}")
         print()
         for name, case in sorted(load_cases().items()):
             tag = "  [smoke]" if case.smoke else ""

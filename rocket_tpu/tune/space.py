@@ -1,10 +1,26 @@
-"""Declarative tune spaces — the LEGAL launch-config set per pallas kernel.
+"""Declarative tune spaces — the LEGAL config set per tunable kernel.
 
 Each tunable kernel declares a :class:`TuneSpace`: the config axes the
 offline tuner (``python -m rocket_tpu.tune``) may sweep, the default
 config (today's hand-picked values — the runtime fallback when no table
 entry matches), and a legality predicate that rejects configs the
-hardware cannot run correctly or efficiently BEFORE anything is timed:
+hardware cannot run correctly or efficiently BEFORE anything is timed.
+
+Axes come in two kinds. **Launch-config axes** (block/tile sizes) pick
+parameters of ONE kernel. **Structural axes** (named in
+:attr:`TuneSpace.structural`) pick between *different traced programs*
+— fusion boundaries (``fused_conv.impl``, ``block_attn.epilogue``),
+whole-kernel variants (``paged_decode.impl``, ``moe_gmm.impl``),
+reduction schedules (``fused_conv.schedule``, ``fused_bn.moments``).
+The search machinery treats both identically (enumerate -> compile ->
+time with compile excluded -> fwd+bwd parity-reject -> table), which is
+the point: a structurally different kernel that is faster but WRONG is
+discarded by the same gate that rejects a bad block size (CUDA-L1
+2507.14111 / AutoKernel 2603.21331 style generate-and-verify). Every
+structural default is the pre-existing path, so absent tables — or
+``ROCKET_TPU_TUNE=0`` — are behavior-identical to an untuned checkout.
+
+Launch-config legality rules (shared by every kernel):
 
 * the flash kernels' causal path masks only diagonal blocks, which is
   correct ONLY when ``block_q == block_k`` (`ops/flash_attention.py`
@@ -81,6 +97,21 @@ class TuneSpace:
     legal: Callable[[dict, Mapping, Optional[DeviceSpec], str], list] = \
         field(default=lambda config, shape, spec, dtype: [])
     doc: str = ""
+    #: Axis names whose candidate values are DIFFERENT TRACED KERNELS
+    #: (implementation variants / fusion choices / schedules), not
+    #: launch parameters of one kernel. Drives the ``--list`` catalog
+    #: and the stale-structural-winner table gate: a checked-in entry
+    #: pinning a variant that no longer exists must fail LOUDLY, never
+    #: silently fall back.
+    structural: Tuple[str, ...] = ()
+    #: Per-dtype (atol, rtol) parity-tolerance OVERRIDES for this
+    #: kernel's sweeps, merged over the tuner's defaults. Scoped here —
+    #: not widened globally — so a kernel whose variants legitimately
+    #: reassociate f32 reductions (fused_conv's tile-sequential moments
+    #: vs XLA's tree) can declare it without loosening the gate for
+    #: every launch-config sweep.
+    parity_tol: Mapping[str, Tuple[float, float]] = \
+        field(default_factory=dict)
 
     def bucket(self, shape: Mapping) -> str:
         """Deterministic shape-bucket string for the table key. Exact
@@ -272,6 +303,17 @@ def _paged_default(shape) -> dict:
     return {"impl": "pallas", "block_kv": _default_block_kv(shape["bl"])}
 
 
+#: Hand-picked defaults, single-sourced: the TuneSpace ``default``
+#: lambdas AND the inert-axis legality pins both read these, so a
+#: default change cannot silently reject its own baseline candidate.
+_GMM_DEFAULT = {"impl": "gmm", "tile_m": 512, "tile_k": 512,
+                "tile_n": 512}
+_FUSED_CONV_DEFAULT = {"impl": "reference", "schedule": "twopass",
+                       "block_rows": 512}
+_BLOCK_ATTN_DEFAULT = {"impl": "reference", "epilogue": "fused",
+                       "block_b": 1}
+
+
 def _gmm_legal(config, shape, spec, dtype) -> list:
     problems = []
     itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
@@ -283,10 +325,117 @@ def _gmm_legal(config, shape, spec, dtype) -> list:
             problems.append(f"{name}={tile} % 128 lane tile")
     if tm % sublane_min(dtype):
         problems.append(f"tile_m={tm} % {sublane_min(dtype)} sublane tile")
+    if config.get("impl", "gmm") == "fused":
+        # The gather-gmm variant (ops/gather_gmm.py) holds the WHOLE
+        # contraction dim per lhs tile (the gathered rows land once, the
+        # n-tiles reuse them) — tile_k is inert; only the default is
+        # enumerated so the cross product never times byte-identical
+        # programs.
+        problems += _inert(
+            config, {"tile_k": _GMM_DEFAULT["tile_k"]},
+            "impl=fused (whole-K lhs scratch)",
+        )
+        if shape["n"] % tn:
+            problems.append(
+                f"tile_n={tn} does not divide N={shape['n']} "
+                "(the fused kernel masks nothing)"
+            )
+        if spec is not None:
+            # Gathered-lhs scratch (full K) + double-buffered rhs/out.
+            need = (tm * shape["k"] + 2 * (shape["k"] * tn + tm * tn)) \
+                * itemsize
+            if need > spec.vmem_bytes:
+                problems.append(
+                    f"VMEM estimate {need >> 20} MiB over the "
+                    f"{spec.kind} budget {spec.vmem_bytes >> 20} MiB"
+                )
+        return problems
     if spec is not None:
         # lhs/rhs/out tiles double-buffered + the f32 accumulator scratch
         # the megablox kernel allocates.
         need = 2 * (tm * tk + tk * tn + tm * tn) * itemsize + tm * tn * 4
+        if need > spec.vmem_bytes:
+            problems.append(
+                f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+def _inert(config, pins: Mapping, why: str) -> list:
+    """Reject non-default values of axes that cannot affect the selected
+    variant — one candidate per byte-identical program."""
+    return [
+        f"{axis}={config[axis]!r} is inert for {why} — only the default "
+        f"{default!r} is enumerated"
+        for axis, default in pins.items()
+        if config.get(axis) != default
+    ]
+
+
+def _fused_conv_legal(config, shape, spec, dtype) -> list:
+    """fused_conv: the 2-phase BN(+relu) epilogue kernel
+    (ops/fused_conv.py) over the flattened (N, C) conv output."""
+    if config["impl"] == "reference":
+        return _inert(
+            config,
+            {k: _FUSED_CONV_DEFAULT[k] for k in ("schedule", "block_rows")},
+            "impl=reference (the unfused XLA chain)",
+        )
+    problems = []
+    itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+    br = config["block_rows"]
+    n, c = shape["n"], shape["c"]
+    if br % sublane_min(dtype):
+        problems.append(
+            f"block_rows={br} % {sublane_min(dtype)} sublane tile ({dtype})"
+        )
+    if n % br:
+        problems.append(
+            f"block_rows={br} does not divide N={n} (the kernel masks "
+            "no ragged tail)"
+        )
+    if spec is not None:
+        # x in + y out tiles double-buffered, + the f32 stat scratch.
+        need = 2 * 2 * br * c * itemsize + 6 * c * 4
+        if need > spec.vmem_bytes:
+            problems.append(
+                f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
+                f"budget {spec.vmem_bytes >> 20} MiB"
+            )
+    return problems
+
+
+def _block_attn_legal(config, shape, spec, dtype) -> list:
+    """block_attn: the whole-block ln1+QKV+attention(+projection) fusion
+    (ops/fused_block.py) — the whole (T, D) sequence rides VMEM."""
+    if config["impl"] == "reference":
+        return _inert(
+            config,
+            {k: _BLOCK_ATTN_DEFAULT[k] for k in ("epilogue", "block_b")},
+            "impl=reference (the per-op layer chain)",
+        )
+    problems = []
+    itemsize = _DTYPE_ITEMSIZE.get(dtype, 4)
+    b, t, d, h = shape["b"], shape["t"], shape["d"], shape["h"]
+    bb = config["block_b"]
+    if b % bb:
+        problems.append(f"block_b={bb} does not divide B={b}")
+    if h <= 0 or d % h or (d // h) % 8:
+        problems.append(
+            f"head layout D={d} H={h} is not lane-minor friendly "
+            "(head_dim % 8)"
+        )
+    if t % sublane_min(dtype):
+        problems.append(
+            f"T={t} % {sublane_min(dtype)} sublane tile ({dtype})"
+        )
+    if spec is not None:
+        # x/out tiles double-buffered + resident weights + the f32
+        # qkv/score intermediates of one row.
+        need = 2 * 2 * bb * t * d * itemsize \
+            + (3 * d * d + d * d + 4 * d) * itemsize \
+            + 4 * (3 * t * d + t * t)
         if need > spec.vmem_bytes:
             problems.append(
                 f"VMEM estimate {need >> 20} MiB over the {spec.kind} "
@@ -338,6 +487,7 @@ TUNE_SPACES: dict[str, TuneSpace] = {
             shape_keys=("s", "mb", "bl", "hkv", "hq", "d"),
             default=_paged_default,
             legal=_paged_legal,
+            structural=("impl",),
             doc="paged-pool decode attention (ops/paged_attention.py): "
                 "impl is a structural axis (fused VMEM-streaming pallas "
                 "kernel vs the XLA gather path — the tuner measures "
@@ -346,26 +496,78 @@ TUNE_SPACES: dict[str, TuneSpace] = {
         ),
         TuneSpace(
             kernel="moe_gmm",
-            axes={"tile_m": (128, 256, 512, 1024),
+            axes={"impl": ("gmm", "fused"),
+                  "tile_m": (128, 256, 512, 1024),
                   "tile_k": (128, 256, 512, 1024),
                   "tile_n": (128, 256, 512, 1024)},
             shape_keys=("m", "k", "n"),
-            default=lambda shape: {"tile_m": 512, "tile_k": 512,
-                                   "tile_n": 512},
+            default=lambda shape: dict(_GMM_DEFAULT),
             legal=_gmm_legal,
-            doc="megablox gmm tiling for the dropless-MoE grouped "
-                "matmuls (nn/moe.py): (m, k, n) tile triple, clamped to "
-                "the operand dims at call",
+            structural=("impl",),
+            doc="dropless-MoE grouped matmuls (nn/moe.py): impl is a "
+                "structural axis — 'gmm' (explicit row gather + "
+                "megablox) vs 'fused' (ops/gather_gmm.py: the token "
+                "gather rides the kernel's own DMA pipeline, no sorted "
+                "copy materializes — aimed at the round-5 dropless "
+                "loss); (m, k, n) tile triple clamped to the operand "
+                "dims at call",
         ),
         TuneSpace(
             kernel="fused_bn",
             axes={"moments": ("stacked", "separate")},
             shape_keys=("c",),
             default=lambda shape: {"moments": "stacked"},
+            structural=("moments",),
             doc="train-mode batchnorm statistics (nn/layers.py "
                 "_bn_train_impl): one stacked (C, 2) moment reduction "
                 "(default — one activation read, one collective under "
                 "data sharding) vs two separate mean/E[x^2] reductions",
+        ),
+        TuneSpace(
+            kernel="fused_conv",
+            axes={"impl": ("reference", "pallas"),
+                  "schedule": ("twopass", "stats_xla"),
+                  "block_rows": (256, 512, 1024)},
+            shape_keys=("n", "c"),
+            default=lambda shape: dict(_FUSED_CONV_DEFAULT),
+            legal=_fused_conv_legal,
+            structural=("impl", "schedule"),
+            # The schedules legitimately reassociate the f32 moment
+            # reduction (tile-sequential vs XLA's tree: ~e-6 on the
+            # statistic, a few e-5 on bench-N gradients); a WRONG kernel
+            # still lands orders of magnitude outside. Scoped here so
+            # the launch-config sweeps keep the tight default.
+            parity_tol={"float32": (5e-5, 5e-5)},
+            doc="conv-stack BN(+relu) epilogue (ops/fused_conv.py via "
+                "nn/layers.bn_act_train): impl 'reference' (the "
+                "_bn_train + relu XLA chain — the bitwise default) vs "
+                "'pallas' (one fused stats+normalize+relu program); "
+                "schedule 'twopass' (in-kernel 2-phase moments) vs "
+                "'stats_xla' (XLA reduction + fused normalize pass); "
+                "block_rows the flattened-activation tile height",
+        ),
+        TuneSpace(
+            kernel="block_attn",
+            axes={"impl": ("reference", "fused"),
+                  "epilogue": ("fused", "separate"),
+                  "block_b": (1, 2, 4, 8)},
+            shape_keys=("b", "t", "d", "h"),
+            default=lambda shape: dict(_BLOCK_ATTN_DEFAULT),
+            legal=_block_attn_legal,
+            structural=("impl", "epilogue"),
+            # Like fused_conv: the fused program reorders f32 LN/softmax
+            # reductions, and the backward (the reference vjp over the
+            # saved inputs) inherits the forward's reassociation through
+            # the cotangent. Scoped; launch sweeps keep the default.
+            parity_tol={"float32": (5e-5, 5e-5)},
+            doc="whole-block attention half (ops/fused_block.py via "
+                "models/transformer.Block): impl 'reference' (the "
+                "per-op ln1+QKV+attention+proj chain — the bitwise "
+                "default) vs 'fused' (ONE pallas program — the "
+                "launch-bound small-model candidate); epilogue 'fused' "
+                "(projection inside the program) vs 'separate' (stop at "
+                "the attention output — the train-dropout shape); "
+                "block_b batch rows per grid step",
         ),
     )
 }

@@ -324,7 +324,29 @@ def _validate_entry(kernel: str, index: int, entry, known_kinds) -> list:
     config = entry["config"]
     if not isinstance(config, Mapping):
         return problems + [f"{where}: config is not an object"]
+    stale_covered = set()
+    for axis in space.structural:
+        value = config.get(axis)
+        if axis in config and value not in space.axes.get(axis, ()):
+            # A structural winner whose variant was removed/renamed must
+            # fail LOUDLY here: get_config would hand the stale value to
+            # the kernel (which raises at trace time), and silently
+            # dropping the entry would mask a real regression — the
+            # measured win is gone either way, so re-tune or drop.
+            problems.append(
+                f"{where}: stale structural winner — {axis}={value!r} is "
+                f"no longer a variant of the {kernel} TuneSpace "
+                f"(candidates: {list(space.axes.get(axis, ()))}); re-tune "
+                "on the device or drop the entry"
+            )
+            # The generic axis-membership violation would now restate
+            # this finding — suppress exactly that message.
+            stale_covered.add(
+                f"{axis}={value!r} not in candidates {space.axes[axis]}"
+            )
     for violation in space.violations(config, shape, spec, entry["dtype"]):
+        if violation in stale_covered:
+            continue
         problems.append(f"{where}: illegal config — {violation}")
     return problems
 
@@ -374,16 +396,43 @@ def validate_tables(configs_dir: Optional[str] = None) -> list:
     return problems
 
 
+def _structural_variant(space, entry) -> Optional[dict]:
+    """The structural-axis values an entry pins AWAY from the default
+    (None when the entry is launch-config-only tuning)."""
+    if not space.structural:
+        return None
+    config = entry.get("config")
+    shape = entry.get("shape")
+    if not isinstance(config, Mapping) or not isinstance(shape, Mapping):
+        return None
+    try:
+        default = space.default(shape)
+    except Exception:  # noqa: BLE001 — summary must survive bad shapes
+        default = {}
+    variant = {
+        axis: config[axis]
+        for axis in space.structural
+        if axis in config and config.get(axis) != default.get(axis)
+    }
+    return variant or None
+
+
 def tables_summary(configs_dir: Optional[str] = None) -> Optional[dict]:
     """Per-kernel entry summary for BENCH_DETAIL's ``tune`` record:
     entry counts plus each entry's (device kind, bucket, dtype, speedup)
-    so tuned-vs-default speedup is tracked per kernel per device kind.
-    None when the directory is entirely absent."""
+    so tuned-vs-default speedup is tracked per kernel per device kind —
+    and ``structural_wins``, the entries whose winning config pins a
+    STRUCTURAL variant away from the default (variant name + the
+    tuner-measured speedup vs the reference implementation), so the
+    generate-and-verify search's wins are tracked per soft-spot config
+    round-over-round. None when the directory is entirely absent."""
     directory = configs_dir or _configs_dir()
     if not os.path.isdir(directory):
         return None
     kernels = {}
+    structural_wins = []
     for kernel in sorted(TUNE_SPACES):
+        space = TUNE_SPACES[kernel]
         table = load_table(kernel, directory, use_cache=False)
         entries = []
         for entry in (table or {}).get("entries", []):
@@ -395,8 +444,25 @@ def tables_summary(configs_dir: Optional[str] = None) -> Optional[dict]:
                             "config", "speedup", "tuned_us", "default_us")
                 if entry.get(key) is not None
             })
-        kernels[kernel] = {"n_entries": len(entries), "entries": entries}
-    return {"kernels": kernels, "source": os.path.relpath(
-        directory, os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-    )}
+            variant = _structural_variant(space, entry)
+            if variant is not None:
+                structural_wins.append({
+                    "kernel": kernel,
+                    "case": entry.get("case"),
+                    "device_kind": entry.get("device_kind"),
+                    "shape_bucket": entry.get("shape_bucket"),
+                    "dtype": entry.get("dtype"),
+                    "variant": variant,
+                    "speedup": entry.get("speedup"),
+                    "tuned_us": entry.get("tuned_us"),
+                    "default_us": entry.get("default_us"),
+                })
+        kernels[kernel] = {
+            "n_entries": len(entries),
+            "entries": entries,
+            "structural_axes": list(space.structural),
+        }
+    return {"kernels": kernels, "structural_wins": structural_wins,
+            "source": os.path.relpath(
+                directory, os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))}

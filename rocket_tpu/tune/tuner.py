@@ -55,6 +55,9 @@ __all__ = [
 #: Parity tolerance per canonical dtype: |tuned - default| <=
 #: atol + rtol * |default|, elementwise over every output leaf (fwd
 #: outputs AND backward grads — both must match for a config to ship).
+#: A kernel whose variants legitimately reassociate f32 reductions can
+#: widen its own bound via ``TuneSpace.parity_tol`` (fused_conv does);
+#: the defaults here stay tight for every launch-config sweep.
 _PARITY_TOL = {
     "bfloat16": (2e-2, 2e-2),
     "float16": (2e-2, 2e-2),
@@ -132,11 +135,14 @@ def _time_run(fn, iters: int) -> float:
     return (time.perf_counter() - t0) / iters * 1e6
 
 
-def check_parity(reference, candidate, dtype: str) -> tuple[bool, float]:
+def check_parity(reference, candidate, dtype: str,
+                 tol: Optional[tuple] = None) -> tuple[bool, float]:
     """Elementwise parity of every output leaf within the dtype
-    tolerance. Returns ``(ok, max_scaled_err)`` where the error is
+    tolerance (or an explicit ``(atol, rtol)`` — the sweep passes the
+    kernel's ``TuneSpace.parity_tol`` override when one is declared).
+    Returns ``(ok, max_scaled_err)`` where the error is
     ``max |a - b| / (atol + rtol * |a|)`` (<= 1 passes)."""
-    atol, rtol = _PARITY_TOL.get(dtype, (1e-5, 1e-5))
+    atol, rtol = tol or _PARITY_TOL.get(dtype, (1e-5, 1e-5))
     ref_leaves = jax.tree.leaves(reference)
     cand_leaves = jax.tree.leaves(candidate)
     if len(ref_leaves) != len(cand_leaves):
@@ -200,7 +206,8 @@ def _sweep_blind(case, space, spec, report, *, iters, min_speedup, log):
             out = run(config)
             _fetch(out)
             result.parity_ok, result.max_err = check_parity(
-                reference, out, case.dtype
+                reference, out, case.dtype,
+                tol=space.parity_tol.get(case.dtype),
             )
             if not result.parity_ok:
                 # A faster wrong kernel is a rejected candidate.
@@ -477,7 +484,15 @@ def _paged_case(name, s, mb, bl, hkv, hq, d, dtype, smoke=False):
                     dtype=canonical_dtype(dtype), build=build, smoke=smoke)
 
 
-def _gmm_case(name, m, k, n, e, dtype):
+def _gmm_case(name, m, k, n, e, dtype, routed=True):
+    """moe_gmm at the dropless-dispatch shape: ``impl`` is the
+    structural axis. ``impl="gmm"`` measures what the model path
+    actually runs — the EXPLICIT row gather (the round-5 ~30 GB/s
+    random-row loser, docs/performance.md) followed by megablox gmm;
+    ``impl="fused"`` the gather-gmm kernel routing the same rows
+    in-kernel. ``routed=False`` (the out-projection case, whose lhs is
+    contiguous in the real dispatch) uses identity routing — the fused
+    variant then measures pure kernel overhead and loses honestly."""
     import jax.numpy as jnp
 
     shape = {"m": m, "k": k, "n": n}
@@ -485,26 +500,166 @@ def _gmm_case(name, m, k, n, e, dtype):
     def build():
         from jax.experimental.pallas.ops.tpu.megablox.ops import gmm
 
+        from rocket_tpu.ops.gather_gmm import gather_gmm
+
         key = jax.random.key(3)
-        kl, kr = jax.random.split(key)
-        lhs = (jax.random.normal(kl, (m, k)) * 0.1).astype(dtype)
+        kl, kr, kp = jax.random.split(key, 3)
+        x = (jax.random.normal(kl, (m, k)) * 0.1).astype(dtype)
         rhs = (jax.random.normal(kr, (e, k, n)) * 0.1).astype(dtype)
+        # Uniform groups (m/e each — a tile multiple for every candidate
+        # at the bench shapes) over a fixed random routing permutation.
         sizes = jnp.full((e,), m // e, jnp.int32)
+        ids = (
+            jax.random.permutation(kp, jnp.arange(m, dtype=jnp.int32))
+            if routed else jnp.arange(m, dtype=jnp.int32)
+        )
+        interpret = jax.devices()[0].platform == "cpu"
 
         @functools.lru_cache(maxsize=None)
-        def compiled(tiling):
-            return jax.jit(lambda a, b, s: gmm(a, b, s, lhs.dtype, tiling))
+        def compiled(impl, tiling):
+            if impl == "fused":
+                # The fused variant always pays its own gather machinery
+                # — with identity ids (routed=False) that is exactly the
+                # overhead it must beat zero of, so it loses honestly.
+                return jax.jit(lambda a, b, s, i: gather_gmm(
+                    a, b, i, s, tile_m=tiling[0], tile_n=tiling[2],
+                    interpret=interpret,
+                ))
+            if routed:
+                return jax.jit(lambda a, b, s, i: gmm(
+                    jnp.take(a, i, axis=0), b, s, a.dtype, tiling
+                ))
+            # The real out-projection consumes already-contiguous rows —
+            # no gather exists on that path, so none is timed (an
+            # identity take would inflate default AND candidates alike
+            # and compress real tile speedups below min_speedup).
+            return jax.jit(lambda a, b, s, i: gmm(a, b, s, a.dtype,
+                                                  tiling))
 
         def run(config):
             cfg = config or TUNE_SPACES["moe_gmm"].default(shape)
             tiling = (min(cfg["tile_m"], m), min(cfg["tile_k"], k),
                       min(cfg["tile_n"], n))
-            return compiled(tiling)(lhs, rhs, sizes)
+            return compiled(cfg.get("impl", "gmm"), tiling)(
+                x, rhs, sizes, ids
+            )
 
         return run
 
     return TuneCase(name=name, kernel="moe_gmm", shape=shape,
                     dtype=canonical_dtype(dtype), build=build)
+
+
+def _fused_conv_case(name, b, hw, c, dtype, smoke=False):
+    """fused_conv at a conv-stack activation shape: fwd+bwd of the
+    BN(+relu) epilogue — impl 'reference' (the unfused chain) is the
+    parity baseline and speedup denominator."""
+    import jax.numpy as jnp
+
+    shape = {"n": b * hw * hw, "c": c}
+
+    def build():
+        from rocket_tpu.ops.fused_conv import fused_bn_act, reference_bn_act
+
+        key = jax.random.key(6)
+        x = (jax.random.normal(key, (b, hw, hw, c)) + 0.5).astype(dtype)
+        scale = jnp.ones((c,), jnp.float32) * 1.5
+        bias = jnp.zeros((c,), jnp.float32)
+        interpret = jax.devices()[0].platform == "cpu"
+
+        @functools.lru_cache(maxsize=None)
+        def compiled(impl, schedule, block_rows):
+            def loss(x, scale, bias):
+                if impl == "pallas":
+                    y, stats = fused_bn_act(
+                        x, scale, bias, eps=1e-5, act=True,
+                        schedule=schedule, block_rows=block_rows,
+                        interpret=interpret,
+                    )
+                else:
+                    y, stats = reference_bn_act(x, scale, bias, 1e-5, True)
+                return (y.astype(jnp.float32) ** 2).sum(), stats
+
+            return jax.jit(jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True
+            ))
+
+        def run(config):
+            cfg = config or {}
+            (l, stats), grads = compiled(
+                cfg.get("impl", "reference"), cfg.get("schedule"),
+                cfg.get("block_rows"),
+            )(x, scale, bias)
+            return l, stats, grads
+
+        return run
+
+    return TuneCase(name=name, kernel="fused_conv", shape=shape,
+                    dtype=canonical_dtype(dtype), build=build, smoke=smoke)
+
+
+def _block_attn_case(name, b, t, d, h, dtype, smoke=False):
+    """block_attn at a small-LM block shape: fwd+bwd of the attention
+    half — impl 'reference' (the per-op chain) is the parity baseline."""
+    import jax.numpy as jnp
+
+    shape = {"b": b, "t": t, "d": d, "h": h}
+
+    def build():
+        from rocket_tpu.ops.fused_block import (
+            block_attn_half,
+            reference_block_attn,
+        )
+
+        key = jax.random.key(7)
+        ks = jax.random.split(key, 6)
+        x = (jax.random.normal(ks[0], (b, t, d)) * 0.5).astype(dtype)
+        ln_s = 1.0 + 0.1 * jax.random.normal(ks[1], (d,))
+        ln_b = 0.1 * jax.random.normal(ks[2], (d,))
+        wqkv = jax.random.normal(ks[3], (d, 3 * d)) * (d ** -0.5)
+        bqkv = jnp.zeros((3 * d,))
+        wproj = jax.random.normal(ks[4], (d, d)) * (d ** -0.5)
+        bproj = jnp.zeros((d,))
+        interpret = jax.devices()[0].platform == "cpu"
+
+        @functools.lru_cache(maxsize=None)
+        def compiled(impl, epilogue, block_b):
+            def loss(x, ln_s, ln_b, wqkv, bqkv, wproj, bproj):
+                if impl == "fused":
+                    y = block_attn_half(
+                        x, ln_s, ln_b, wqkv, bqkv, wproj, bproj,
+                        num_heads=h, epilogue=epilogue, block_b=block_b,
+                        interpret=interpret,
+                    )
+                    if epilogue == "separate":
+                        # Projection applied outside the kernel (XLA) so
+                        # the output surface — and therefore parity —
+                        # stays comparable to the baseline.
+                        y = y @ wproj.astype(y.dtype) \
+                            + bproj.astype(y.dtype)
+                else:
+                    # The reference chain has no epilogue split —
+                    # legality pins the axis inert for impl=reference.
+                    y = reference_block_attn(
+                        x, ln_s, ln_b, wqkv, bqkv, wproj, bproj,
+                        num_heads=h, epilogue="fused",
+                    )
+                return (y.astype(jnp.float32) ** 2).sum()
+
+            return jax.jit(jax.value_and_grad(loss, argnums=(0, 3, 5)))
+
+        def run(config):
+            cfg = config or {}
+            loss, grads = compiled(
+                cfg.get("impl", "reference"), cfg.get("epilogue", "fused"),
+                cfg.get("block_b", 1),
+            )(x, ln_s, ln_b, wqkv, bqkv, wproj, bproj)
+            return (loss,) + grads
+
+        return run
+
+    return TuneCase(name=name, kernel="block_attn", shape=shape,
+                    dtype=canonical_dtype(dtype), build=build, smoke=smoke)
 
 
 def _bn_case(name, b, hw, c, dtype, smoke=False):
@@ -574,8 +729,17 @@ def _builtin_cases() -> list:
         _gmm_case("gmm/moe_bench", m=16384, k=768, n=3072, e=4,
                   dtype=bf16),
         _gmm_case("gmm/moe_bench_out", m=16384, k=3072, n=768, e=4,
-                  dtype=bf16),
+                  dtype=bf16, routed=False),
         _bn_case("bn/resnet18", b=256, hw=32, c=64, dtype=bf16),
+        # The structural soft-spot candidates (ROADMAP item 4): the
+        # conv-stack BN(+relu) epilogue at the resnet18/50 stem shapes,
+        # and the whole-block attention half at the charlm block shape.
+        _fused_conv_case("fused_conv/resnet18", b=256, hw=32, c=64,
+                         dtype=bf16),
+        _fused_conv_case("fused_conv/resnet50", b=128, hw=56, c=64,
+                         dtype=bf16),
+        _block_attn_case("block_attn/charlm", b=64, t=256, d=256, h=4,
+                         dtype=bf16),
         # CPU smoke subset: tiny shapes that run interpreted in seconds.
         _flash_fwd_case("flash_fwd/smoke", b=2, t=256, h=2, d=64,
                         h_kv=2, dtype=bf16, smoke=True),
@@ -586,6 +750,10 @@ def _builtin_cases() -> list:
         _paged_case("paged/smoke", s=2, mb=2, bl=16, hkv=2, hq=2, d=16,
                     dtype=jnp.float32, smoke=True),
         _bn_case("bn/smoke", b=8, hw=8, c=16, dtype=bf16, smoke=True),
+        _fused_conv_case("fused_conv/smoke", b=8, hw=8, c=16,
+                         dtype=jnp.float32, smoke=True),
+        _block_attn_case("block_attn/smoke", b=4, t=64, d=128, h=2,
+                         dtype=jnp.float32, smoke=True),
     ]
 
 

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
-# tune table gate (checked-in kernel-config legality) + SPMD shard
+# tune table gate (checked-in kernel-config legality + stale structural
+# winners) + structural kernel-search smoke + SPMD shard
 # audit (self-gate + budget diff) + precision audit (dtype-flow
 # self-gate + numerics budgets) + schedule audit + calibration audit
 # (live device-trace capture reconciled against the priced HLO DAG +
@@ -23,10 +24,21 @@ JAX_PLATFORMS=cpu python -m rocket_tpu.analysis rocket_tpu/
 
 echo "== tune table gate (schema + legality of checked-in kernel configs) =="
 # Validates every entry in rocket_tpu/tune/configs/*.json: schema
-# fields, known device kinds, bucket/shape consistency, and a fresh
-# legality re-verification against each kernel's TuneSpace — a stale or
-# hand-edited table cannot ship an illegal launch config.
+# fields, known device kinds, bucket/shape consistency, a fresh
+# legality re-verification against each kernel's TuneSpace, and the
+# stale-structural-winner check — a stale or hand-edited table cannot
+# ship an illegal launch config or a retired kernel variant.
 JAX_PLATFORMS=cpu python -m rocket_tpu.tune --check-table
+
+echo "== structural kernel search smoke (enumerate -> verify -> table round-trip + seeded-bad rejection) =="
+# The generate-and-verify loop on CPU interpret mode (ISSUE 14): the
+# structural TuneSpaces (fused_conv / block_attn) must enumerate their
+# variant candidates and pass fwd+bwd parity on every one, a written
+# structural winner must round-trip through the runtime lookup and
+# tables_summary, a seeded wrong-but-fast fake variant must be REJECTED
+# by the parity gate before timing, and a table entry pinning a retired
+# variant must fail the table gate loudly.
+JAX_PLATFORMS=cpu python scripts/tune_structural_smoke.py
 
 echo "== shard audit (SPMD self-gate + budgets) =="
 # Fake 1x8 / 2x4 CPU meshes; fails on sharding-rule findings or a >10%

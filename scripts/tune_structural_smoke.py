@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Structural-kernel-search smoke: the generate-and-verify loop, CPU-only.
+
+Proves ISSUE 14's acceptance spine without hardware, in four legs:
+
+1. **enumerate -> verify**: the CPU smoke sweeps of the structural
+   TuneSpaces (``fused_conv``, ``block_attn`` — interpret mode) must
+   enumerate their variant candidates and pass fwd+bwd parity on EVERY
+   one: no candidate errors, no rejections among the shipped variants.
+2. **table round-trip**: a structural winner written to a table must
+   resolve through the runtime lookup for its (device kind, bucket,
+   dtype) key, validate clean against the TuneSpace, and surface in
+   ``tables_summary``'s ``structural_wins``.
+3. **seeded-bad rejection** (the true-positive leg the whole PR rests
+   on): a deliberately wrong-but-fast fake variant registered in a
+   test-only TuneSpace must be REJECTED by the sweep's parity gate —
+   never timed into the ranking, never a winner.
+4. **stale structural winner**: a table entry pinning a variant that no
+   longer exists in its TuneSpace must fail ``validate_tables`` loudly.
+
+Exit non-zero on the first failing leg (CI wiring: scripts/check.sh).
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def fail(msg: str) -> None:
+    print(f"tune_structural_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def leg_enumerate_verify():
+    from rocket_tpu.tune.tuner import load_cases, sweep_case
+
+    for name in ("fused_conv/smoke", "block_attn/smoke"):
+        case = load_cases()[name]
+        report = sweep_case(case, iters=1, log=lambda s: None)
+        if not report.results:
+            fail(f"{name}: no candidates enumerated")
+        impls = {r.config.get("impl") for r in report.results}
+        if impls == {"reference"}:
+            fail(f"{name}: no structural variant enumerated")
+        for r in report.results:
+            if r.error is not None:
+                fail(f"{name}: candidate {r.config} errored: {r.error}")
+            if not r.parity_ok:
+                fail(f"{name}: candidate {r.config} failed parity "
+                     f"(err={r.max_err:.3g}) — a shipped variant must be "
+                     "numerically faithful")
+        print(f"tune_structural_smoke: {name} — "
+              f"{len(report.results)} candidates enumerated, all "
+              "parity-clean")
+
+
+def leg_table_round_trip():
+    import jax.numpy as jnp
+
+    from rocket_tpu import tune
+    from rocket_tpu.tune.space import TUNE_SPACES
+
+    shape = {"b": 64, "t": 256, "d": 256, "h": 4}
+    space = TUNE_SPACES["block_attn"]
+    entry = {
+        "device_kind": "TPU v5 lite",
+        "dtype": "bfloat16",
+        "shape": shape,
+        "shape_bucket": space.bucket(shape),
+        "config": {"impl": "fused", "epilogue": "fused", "block_b": 2},
+        "speedup": 1.31,
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ["ROCKET_TPU_TUNE_DIR"] = tmp
+        tune.reset_table_cache()
+        try:
+            for kernel in TUNE_SPACES:
+                tune.write_table(kernel, [entry] if kernel == "block_attn"
+                                 else [])
+            problems = tune.validate_tables(tmp)
+            if problems:
+                fail(f"round-trip table did not validate: {problems}")
+            with tune.priced_device_kind("TPU v5 lite"):
+                hit = tune.get_config("block_attn", shape=shape,
+                                      dtype=jnp.bfloat16)
+            if hit != entry["config"]:
+                fail(f"lookup returned {hit!r}, wanted the structural "
+                     f"winner {entry['config']!r}")
+            summary = tune.tables_summary(tmp)
+            wins = summary["structural_wins"]
+            if not any(w["kernel"] == "block_attn"
+                       and w["variant"].get("impl") == "fused"
+                       for w in wins):
+                fail(f"structural win missing from tables_summary: {wins}")
+        finally:
+            del os.environ["ROCKET_TPU_TUNE_DIR"]
+            tune.reset_table_cache()
+    print("tune_structural_smoke: table round-trip — structural winner "
+          "resolves, validates, and surfaces in structural_wins")
+
+
+def leg_seeded_bad_rejection():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocket_tpu.tune.space import TUNE_SPACES, TuneSpace
+    from rocket_tpu.tune.tuner import TuneCase, sweep_case
+
+    space = TuneSpace(
+        kernel="smoke_fake",
+        axes={"impl": ("reference", "wrongfast")},
+        shape_keys=("n",),
+        default=lambda shape: {"impl": "reference"},
+        structural=("impl",),
+        doc="test-only: 'wrongfast' returns a scaled (wrong) output "
+            "instantly — the parity gate must discard it",
+    )
+    TUNE_SPACES[space.kernel] = space
+    try:
+        x = jnp.asarray(np.linspace(0.0, 1.0, 256, dtype=np.float32))
+
+        def build():
+            def run(config):
+                if (config or {}).get("impl") == "wrongfast":
+                    return x * 1.5  # fast AND wrong
+                return x
+            return run
+
+        case = TuneCase(name="fake/seeded_bad", kernel="smoke_fake",
+                        shape={"n": 256}, dtype="float32", build=build)
+        report = sweep_case(case, iters=1, min_speedup=1.0)
+        bad = [r for r in report.results
+               if r.config == {"impl": "wrongfast"}]
+        if not bad:
+            fail("wrongfast variant was never enumerated")
+        if bad[0].parity_ok:
+            fail("wrongfast variant PASSED parity — the rejection gate "
+                 "is broken")
+        if bad[0].mean_us is not None:
+            fail("wrongfast variant was timed — rejection must precede "
+                 "ranking")
+        if report.winner is not None:
+            fail(f"sweep crowned a winner {report.winner.config!r} from "
+                 "a wrong variant")
+    finally:
+        del TUNE_SPACES[space.kernel]
+    print("tune_structural_smoke: seeded-bad — wrong-but-fast variant "
+          "rejected by the parity gate before timing")
+
+
+def leg_stale_structural_winner():
+    from rocket_tpu import tune
+    from rocket_tpu.tune.space import TUNE_SPACES
+
+    shape = {"n": 262144, "c": 64}
+    with tempfile.TemporaryDirectory() as tmp:
+        for kernel in TUNE_SPACES:
+            tune.write_table(kernel, [{
+                "device_kind": "TPU v5 lite",
+                "dtype": "bfloat16",
+                "shape": shape,
+                "shape_bucket": TUNE_SPACES["fused_conv"].bucket(shape),
+                "config": {"impl": "retired_variant",
+                           "schedule": "twopass", "block_rows": 512},
+            }] if kernel == "fused_conv" else [], configs_dir=tmp)
+        problems = tune.validate_tables(tmp)
+        stale = [p for p in problems if "stale structural winner" in p]
+        if not stale:
+            fail(f"retired variant not flagged as stale: {problems}")
+    print("tune_structural_smoke: stale structural winner — retired "
+          "variant fails the table gate loudly")
+
+
+LEGS = {
+    "enumerate": leg_enumerate_verify,
+    "roundtrip": leg_table_round_trip,
+    "seeded-bad": leg_seeded_bad_rejection,
+    "stale": leg_stale_structural_winner,
+}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--leg", choices=sorted(LEGS), default=None,
+        help="run ONE leg standalone (CI attribution steps); default "
+             "runs all four",
+    )
+    args = parser.parse_args(argv)
+    if args.leg:
+        LEGS[args.leg]()
+    else:
+        for leg in ("enumerate", "roundtrip", "seeded-bad", "stale"):
+            LEGS[leg]()
+    print("tune_structural_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,7 +236,8 @@ def test_sched_list_targets():
     proc = run_cli("sched", "--list-targets")
     assert proc.returncode == 0
     for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "dp_resnet_1x8",
-                 "tp_flash", "badsched", "badoverlap", "badpallas"):
+                 "tp_flash", "fused_kernels", "badsched", "badoverlap",
+                 "badpallas"):
         assert name in proc.stdout
 
 

@@ -341,6 +341,11 @@ def test_write_detail_carries_tune_record(tmp_path):
         for entry in row["entries"]:
             assert entry["device_kind"] and entry["shape_bucket"]
             assert entry["speedup"] > 1.0  # only wins are persisted
+        assert isinstance(row["structural_axes"], list)
+    # The structural-variant scoreboard (ISSUE 14) rides the same
+    # record: a list (empty while the shipped tables carry no wins),
+    # carried across probe-less runs like the rest.
+    assert isinstance(record["structural_wins"], list)
     assert record["device_kind"]
     assert record["source"].endswith(os.path.join("tune", "configs"))
 

@@ -645,3 +645,178 @@ def test_update_tables_merges_other_device_kinds(tmp_path):
     assert kinds == {"TPU v4", "TPU v5 lite"}
     new = [e for e in entries if e["device_kind"] == "TPU v5 lite"][0]
     assert new["speedup"] == 1.25 and new["config"]["block_q"] == 128
+
+
+# -- structural axes (ISSUE 14) ----------------------------------------------
+
+
+def test_fused_conv_space_axes_and_inert_pinning():
+    """impl/schedule are structural; impl=reference pins the launch
+    axes inert so the cross product never times byte-identical
+    programs."""
+    from rocket_tpu.utils.perf import device_spec
+
+    space = TUNE_SPACES["fused_conv"]
+    assert set(space.axes) == {"impl", "schedule", "block_rows"}
+    assert set(space.structural) == {"impl", "schedule"}
+    shape = {"n": 262144, "c": 64}
+    assert space.default(shape) == {
+        "impl": "reference", "schedule": "twopass", "block_rows": 512,
+    }
+    spec = device_spec("TPU v5 lite")
+    candidates = space.candidates(shape, spec, "bfloat16")
+    refs = [c for c in candidates if c["impl"] == "reference"]
+    assert refs == [space.default(shape)]  # one reference candidate
+    assert {"impl": "pallas", "schedule": "stats_xla",
+            "block_rows": 256} in candidates
+    # block_rows must divide N for the pallas variant.
+    assert space.violations(
+        {"impl": "pallas", "schedule": "twopass", "block_rows": 512},
+        {"n": 1000, "c": 64}, spec, "bfloat16",
+    )
+
+
+def test_block_attn_space_axes_and_inert_pinning():
+    from rocket_tpu.utils.perf import device_spec
+
+    space = TUNE_SPACES["block_attn"]
+    assert set(space.axes) == {"impl", "epilogue", "block_b"}
+    assert set(space.structural) == {"impl", "epilogue"}
+    shape = {"b": 64, "t": 256, "d": 256, "h": 4}
+    spec = device_spec("TPU v5 lite")
+    candidates = space.candidates(shape, spec, "bfloat16")
+    refs = [c for c in candidates if c["impl"] == "reference"]
+    assert refs == [space.default(shape)]
+    fused = [c for c in candidates if c["impl"] == "fused"]
+    assert {c["epilogue"] for c in fused} == {"fused", "separate"}
+    assert space.violations(
+        {"impl": "fused", "epilogue": "fused", "block_b": 8},
+        {"b": 4, "t": 256, "d": 256, "h": 4}, spec, "bfloat16",
+    )  # block_b does not divide B
+
+
+def test_moe_gmm_impl_axis():
+    """moe_gmm grew the structural impl axis: 'gmm' stays the default
+    (bitwise pre-existing behavior) and 'fused' pins tile_k inert."""
+    from rocket_tpu.utils.perf import device_spec
+
+    space = TUNE_SPACES["moe_gmm"]
+    assert space.structural == ("impl",)
+    shape = {"m": 16384, "k": 768, "n": 3072}
+    assert space.default(shape)["impl"] == "gmm"
+    spec = device_spec("TPU v5 lite")
+    candidates = space.candidates(shape, spec, "bfloat16")
+    fused = [c for c in candidates if c["impl"] == "fused"]
+    assert fused and all(c["tile_k"] == 512 for c in fused)
+    assert space.violations(
+        {"impl": "fused", "tile_m": 512, "tile_k": 256, "tile_n": 512},
+        shape, spec, "bfloat16",
+    )  # tile_k inert for the fused variant
+
+
+def test_stale_structural_winner_fails_loudly(tmp_path):
+    """A table entry pinning a variant that no longer exists must be a
+    named gate failure, not a silent fallback."""
+    shape = {"b": 64, "t": 256, "d": 256, "h": 4}
+    for kernel in TUNE_SPACES:
+        tune.write_table(kernel, [{
+            "device_kind": "TPU v5 lite", "dtype": "bfloat16",
+            "shape": shape,
+            "shape_bucket": TUNE_SPACES["block_attn"].bucket(shape),
+            "config": {"impl": "whole_block_v0", "epilogue": "fused",
+                       "block_b": 1},
+        }] if kernel == "block_attn" else [], configs_dir=str(tmp_path))
+    problems = "\n".join(tune.validate_tables(str(tmp_path)))
+    assert "stale structural winner" in problems
+    assert "whole_block_v0" in problems
+
+
+def test_bad_table_fixture_flags_stale_structural_winner():
+    problems = "\n".join(tune.validate_tables(BAD_TABLE_DIR))
+    assert "stale structural winner" in problems
+
+
+def test_sweep_rejects_wrong_fast_structural_variant():
+    """The true-positive leg the whole structural search rests on: a
+    deliberately wrong-but-fast variant in a test-only TuneSpace must
+    be discarded by the parity gate BEFORE timing enters the ranking."""
+    from rocket_tpu.tune.space import TuneSpace
+
+    space = TuneSpace(
+        kernel="test_fake_variant",
+        axes={"impl": ("reference", "wrongfast")},
+        shape_keys=("n",),
+        default=lambda shape: {"impl": "reference"},
+        structural=("impl",),
+    )
+    TUNE_SPACES[space.kernel] = space
+    try:
+        x = jnp.asarray(np.linspace(0.0, 1.0, 128, dtype=np.float32))
+
+        def build():
+            def run(config):
+                if (config or {}).get("impl") == "wrongfast":
+                    return x * 1.5
+                return x
+
+            return run
+
+        case = TuneCase(name="fake/wrongfast", kernel="test_fake_variant",
+                        shape={"n": 128}, dtype="float32", build=build)
+        report = sweep_case(case, iters=1, min_speedup=1.0)
+        (bad,) = [r for r in report.results
+                  if r.config == {"impl": "wrongfast"}]
+        assert not bad.parity_ok
+        assert bad.mean_us is None  # rejected before timing
+        assert report.winner is None
+    finally:
+        del TUNE_SPACES[space.kernel]
+
+
+def test_tables_summary_reports_structural_wins(tmp_path):
+    shape = {"b": 64, "t": 256, "d": 256, "h": 4}
+    for kernel in TUNE_SPACES:
+        tune.write_table(kernel, [{
+            "device_kind": "TPU v5 lite", "dtype": "bfloat16",
+            "shape": shape,
+            "shape_bucket": TUNE_SPACES["block_attn"].bucket(shape),
+            "config": {"impl": "fused", "epilogue": "separate",
+                       "block_b": 2},
+            "speedup": 1.42, "case": "block_attn/charlm",
+        }] if kernel == "block_attn" else [], configs_dir=str(tmp_path))
+    summary = tune.tables_summary(str(tmp_path))
+    (win,) = summary["structural_wins"]
+    assert win["kernel"] == "block_attn"
+    assert win["variant"] == {"impl": "fused", "epilogue": "separate"}
+    assert win["speedup"] == 1.42
+    assert summary["kernels"]["block_attn"]["structural_axes"] == [
+        "impl", "epilogue",
+    ]
+    # Launch-config-only tuning (the default impl) is NOT a structural
+    # win.
+    tune.write_table("block_attn", [{
+        "device_kind": "TPU v5 lite", "dtype": "bfloat16",
+        "shape": shape,
+        "shape_bucket": TUNE_SPACES["block_attn"].bucket(shape),
+        "config": {"impl": "reference", "epilogue": "fused",
+                   "block_b": 1},
+    }], configs_dir=str(tmp_path))
+    assert tune.tables_summary(str(tmp_path))["structural_wins"] == []
+
+
+def test_list_cli_marks_structural_axes(capsys):
+    from rocket_tpu.tune.__main__ import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "impl*=" in out            # structural axes starred
+    assert "structural axes" in out
+    assert "block_attn" in out and "fused_conv" in out
+    assert "fused_conv/smoke" in out  # case catalog carries the smokes
+
+
+def test_check_alias_matches_check_table():
+    from rocket_tpu.tune.__main__ import main
+
+    assert main(["--check"]) == 0
+    assert main(["--check", "--table-dir", BAD_TABLE_DIR]) == 1
