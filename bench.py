@@ -945,6 +945,23 @@ def _tune_provenance():
 
 #: Serving-budget directory the serve auditor maintains
 #: (``python -m rocket_tpu.analysis serve --update-budgets``).
+#: Peak-HBM budget directory the memory auditor maintains
+#: (``python -m rocket_tpu.analysis mem --update-budgets``).
+MEM_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "mem",
+)
+
+
+def mem_audit_summary(budgets_dir=MEM_BUDGETS_DIR):
+    """The audited per-device peak-HBM prediction and saved-activation
+    bytes for the repo's canonical train/eval configs, from the records
+    the memory self-gate verifies every CI run."""
+    return _budget_summary(
+        budgets_dir, "MEM_GATED_KEYS", "tests/fixtures/budgets/mem"
+    )
+
+
 SERVE_BUDGETS_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "tests", "fixtures", "budgets", "serve",
@@ -1504,6 +1521,12 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
         # measured ITL/TTFT calibration — model/reality drift is tracked.
         _carry_calibration(serve_audit, prior.get("serve_audit"))
         detail["serve_audit"] = serve_audit
+    mem = mem_audit_summary(MEM_BUDGETS_DIR)
+    if mem is not None:
+        # Statically-predicted peak HBM + saved-for-backward bytes per
+        # train target (mem_audit budgets) — the liveness simulation's
+        # numbers the memory self-gate verifies every CI run.
+        detail["mem"] = mem
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

@@ -33,6 +33,14 @@ rule catalog):
   exposed communication; exposed/convoyed collectives, memory-bound
   critical paths, pallas block misfits, predicted-MFU floors and
   schedule budgets. CLI: ``python -m rocket_tpu.analysis sched``.
+* :mod:`~rocket_tpu.analysis.mem_audit` — static HBM liveness audit:
+  the AOT-compiled step's scheduled HLO replayed as a buffer-liveness
+  simulation (donation-aware, async-collective-aware); the peak
+  watermark attributed into state / batch / saved-for-backward
+  activations / collectives / temps, cross-checked against XLA's own
+  ``memory_analysis()``, with donation-coverage proofs, remat
+  ceilings, per-target peak budgets and an OOM frontier (max batch per
+  device kind). CLI: ``python -m rocket_tpu.analysis mem``.
 * strict mode — ``Runtime(strict=True)`` (``runtime/context.py``): a
   ``jax.transfer_guard`` plus a retrace counter enforcing the same
   contracts on a live run; the SPMD auditor's collective count is
@@ -54,10 +62,16 @@ from rocket_tpu.analysis.prec_audit import (
     certify_collectives,
     collect_dtype_flow,
 )
+from rocket_tpu.analysis.mem_audit import (
+    MemAuditReport,
+    audit_memory,
+    simulate_liveness,
+)
 from rocket_tpu.analysis.rocketlint import lint_file, lint_paths, lint_source
 from rocket_tpu.analysis.rules import (
     AST_RULES,
     AUDIT_RULES,
+    MEM_RULES,
     PREC_RULES,
     SCHED_RULES,
     SPMD_RULES,
@@ -103,10 +117,14 @@ __all__ = [
     "SchedAuditReport",
     "collect_pallas_facts",
     "predict_compiled",
+    "audit_memory",
+    "MemAuditReport",
+    "simulate_liveness",
     "AST_RULES",
     "AUDIT_RULES",
     "SPMD_RULES",
     "PREC_RULES",
     "SCHED_RULES",
+    "MEM_RULES",
     "all_rules",
 ]

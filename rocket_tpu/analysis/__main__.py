@@ -1,7 +1,7 @@
 """CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` |
-``prec`` | ``sched`` | ``serve``.
+``prec`` | ``sched`` | ``serve`` | ``calib`` | ``mem``.
 
-Five entry forms, one process contract (exit 0 = clean, 1 = findings,
+Several entry forms, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
 (:func:`~rocket_tpu.analysis.findings.emit_findings`):
 
@@ -29,7 +29,14 @@ Five entry forms, one process contract (exit 0 = clean, 1 = findings,
   ITL/TTFT per device kind), the scheduler driven through the full
   admission lattice for the retrace-surface proof, KV-pool HBM fit
   with the (slots, blocks) frontier, pool-donation/host-transfer
-  checks, and the serving budgets.
+  checks, and the serving budgets;
+* ``mem`` audits the *memory story* of the same canonical train steps
+  (:mod:`rocket_tpu.analysis.mem_audit`): buffer liveness simulated
+  over the as-compiled op order — peak HBM attributed into params /
+  optimizer state / saved-for-backward activations / collective
+  buffers / temps, donation-coverage proof, remat effectiveness, the
+  OOM frontier per device kind, a reconciliation cross-check against
+  ``compiled.memory_analysis()``, and the memory budgets.
 
 The audit subcommands are one registry (:data:`AUDIT_SUBCOMMANDS`)
 sharing a single flag set and budget write/diff loop, so ``--format``
@@ -116,6 +123,12 @@ def _load_calib():
     return CALIB_TARGETS, run_calib_target
 
 
+def _load_mem():
+    from rocket_tpu.analysis.mem_audit import MEM_TARGETS, run_mem_target
+
+    return MEM_TARGETS, run_mem_target
+
+
 def _mesh_line(target) -> str:
     return (
         f"mesh={'x'.join(str(s) for s in target.mesh_shape.values())} "
@@ -194,6 +207,22 @@ AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
                 f"kind={t.kind} priced_for={t.device_kind}"
                 if t.kind == "train"
                 else f"kind={t.kind} budget=serve/{t.serve_budget}"
+            ),
+        ),
+        AuditCLI(
+            name="mem",
+            description="static HBM liveness audit: peak-memory "
+                        "watermark with attribution, donation-coverage "
+                        "proof, remat effectiveness, OOM frontier per "
+                        "device kind, memory_analysis reconciliation",
+            load=_load_mem,
+            budgets_dir_attr="MEM_DIR",
+            gated_keys_attr="MEM_GATED_KEYS",
+            budget_rule="RKT803",
+            family="mem",
+            list_line=lambda t: (
+                f"{_mesh_line(t)} device={t.device_kind}"
+                + ("" if t.expects_donation else "  [eval]")
             ),
         ),
     )

@@ -45,6 +45,7 @@ __all__ = [
     "SCHED_GATED_KEYS",
     "SERVE_GATED_KEYS",
     "CALIB_GATED_KEYS",
+    "MEM_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -85,6 +86,15 @@ CALIB_GATED_KEYS = ("abs_calib_error", "unjoined_fraction")
 SERVE_GATED_KEYS = ("predicted_itl_us", "predicted_ttft_us",
                     "hbm_total_bytes")
 
+#: Record keys the memory gate compares — RKT803. Both are monotone
+#: cost metrics of the static liveness simulation
+#: (rocket_tpu.analysis.mem_audit): the simulated peak-HBM watermark of
+#: the compiled train step and the saved-for-backward activation bytes
+#: (the remat-sensitive slice of it). A dropped donation or a lost
+#: remat boundary grows one of them long before anyone OOMs on
+#: hardware.
+MEM_GATED_KEYS = ("predicted_peak_bytes", "saved_activation_bytes")
+
 #: Default budgets directory, resolved relative to the repo checkout.
 #: The precision/schedule/serving budgets live in ``prec/`` / ``sched/``
 #: / ``serve/`` subdirectories so BENCH's per-target sweep over
@@ -94,6 +104,7 @@ PREC_DIR = os.path.join(DEFAULT_DIR, "prec")
 SCHED_DIR = os.path.join(DEFAULT_DIR, "sched")
 SERVE_DIR = os.path.join(DEFAULT_DIR, "serve")
 CALIB_DIR = os.path.join(DEFAULT_DIR, "calib")
+MEM_DIR = os.path.join(DEFAULT_DIR, "mem")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -142,7 +153,7 @@ def diff_budget(
     path = f"<{family}:{target}>"
     subcommand = {
         "spmd": "shard", "sched": "sched", "serve": "serve",
-        "calib": "calib",
+        "calib": "calib", "mem": "mem",
     }.get(family, "prec")
     if committed is None:
         return [Finding(

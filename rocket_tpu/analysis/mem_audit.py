@@ -1,0 +1,619 @@
+"""Static HBM liveness / donation / OOM-frontier audit (RKT801-805).
+
+The schedule auditor prices the compiled step's *time*; this module
+prices its *space*, on the same fake-mesh AOT compile: the scheduled
+HLO text (``is_scheduled=true`` — text order IS the schedule) is parsed
+with :func:`~rocket_tpu.analysis.sched_audit.parse_hlo_module` and
+buffer liveness is simulated over the as-compiled op order. A buffer is
+born at its producer's schedule index and dies after its last consumer;
+aliasing opcodes (bitcast / tuple / get-tuple-element / async ``-done``
+halves) add no bytes; donated outputs (the module's
+``input_output_alias`` map — XLA's own proof the update happens in
+place) write into their parameter buffers and add no bytes either. The
+peak of the resulting watermark is attributed into params+optimizer
+state / batch / saved-for-backward activations (buffers carried ACROSS
+the watermark — born before it, consumed after it; at a train step the
+peak sits at the forward/backward boundary, so these are exactly the
+residuals a remat policy controls) / collective buffers / temps, and
+cross-checked against ``compiled.memory_analysis()`` so a parser or
+liveness divergence fails loudly (RKT805) instead of silently
+mispricing every other number.
+
+Pure abstract evaluation + XLA compilation — no FLOPs run, no params
+materialize, no TPU required. CLI: ``python -m rocket_tpu.analysis mem``
+(budgets under ``tests/fixtures/budgets/mem/``).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
+
+import jax
+
+from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.rules.mem_rules import (
+    check_donation_coverage,
+    check_oom_frontier,
+    check_reconciliation,
+    check_remat_effectiveness,
+)
+from rocket_tpu.analysis.sched_audit import (
+    DEFAULT_DEVICE_KIND,
+    _comm_base_kind,
+    HloInstr,
+    parse_hlo_module,
+)
+from rocket_tpu.analysis.shard_audit import (
+    _leaf_nbytes,
+    _mesh_from_shape,
+    _shard_factor,
+    aot_compile_step,
+    resolve_placement,
+)
+from rocket_tpu.utils.perf import DEVICE_SPECS, device_spec
+
+__all__ = [
+    "LivenessResult",
+    "simulate_liveness",
+    "MemAuditReport",
+    "audit_memory",
+    "MemTarget",
+    "MEM_TARGETS",
+    "run_mem_target",
+]
+
+#: Opcodes whose result aliases (a view of) their operands — no new
+#: allocation. Async ``-done`` halves are handled by suffix (the done
+#: extracts the start's already-allocated result element).
+_ALIAS_OPS = frozenset({
+    "bitcast", "tuple", "get-tuple-element", "optimization-barrier",
+})
+
+_IO_ALIAS_ENTRY_RE = re.compile(
+    r"\{(\d+)[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(?:may|must)-alias\)"
+)
+_PARAM_NUM_RE = re.compile(r"%([\w\.\-]+) = [^=]*?parameter\((\d+)\)")
+_ROOT_RE = re.compile(r"^\s*ROOT %([\w\.\-]+) = ", re.MULTILINE)
+
+
+def _parse_io_alias(hlo_text: str) -> dict[int, int]:
+    """``input_output_alias`` from the HloModule header: top-level output
+    tuple index -> donated parameter number."""
+    # The alias map sits inside nested braces on the header line; grab
+    # everything between `input_output_alias={` and the matching close
+    # by scanning (the entries themselves contain `{}` pairs).
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return {}
+    i = hlo_text.find("{", start)
+    depth = 0
+    for j in range(i, min(len(hlo_text), i + 1 << 16)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    block = hlo_text[i:j + 1]
+    return {
+        int(out): int(param)
+        for out, param in _IO_ALIAS_ENTRY_RE.findall(block)
+    }
+
+
+@dataclass
+class LivenessResult:
+    """The simulated watermark and its attribution."""
+
+    peak_bytes: int                  # arguments + peak live temps
+    peak_temp_bytes: int
+    peak_index: int                  # schedule index of the watermark
+    argument_bytes: int              # all parameter buffers (live whole step)
+    donated_arg_bytes: int           # params+opt state proven in-place
+    undonated_arg_bytes: int         # batch + anything NOT donated
+    saved_activation_bytes: int      # carried across the peak watermark
+    #: live-at-peak attribution: state / batch / saved_activations /
+    #: collectives / temps (bytes each)
+    peak_breakdown: dict = field(default_factory=dict)
+    n_buffers: int = 0
+
+
+def simulate_liveness(
+    entry: Sequence[HloInstr],
+    hlo_text: str = "",
+) -> LivenessResult:
+    """Simulate buffer liveness over the scheduled entry computation.
+
+    Temp buffers are born at their producer's index and die after their
+    last consumer (no consumer = a root output, live to the end).
+    Donated outputs (``input_output_alias``) write into their parameter
+    buffers and count zero new bytes, which is exactly what donation
+    buys at runtime.
+    """
+    by_name = {i.name: i for i in entry}
+    io_alias = _parse_io_alias(hlo_text)
+    param_num = {
+        name: int(num)
+        for name, num in _PARAM_NUM_RE.findall(hlo_text)
+        if name in by_name
+    }
+    root_names = {
+        name for name in _ROOT_RE.findall(hlo_text) if name in by_name
+    }
+
+    end = len(entry)
+    alias_sets: dict[str, frozenset] = {}
+    born: dict[str, int] = {}
+    nbytes: dict[str, int] = {}
+    last_use: dict[str, int] = {}
+    producer: dict[str, HloInstr] = {}
+    is_arg: dict[str, bool] = {}
+
+    for idx, instr in enumerate(entry):
+        for operand in set(instr.operands):
+            for buf in alias_sets.get(operand, ()):
+                last_use[buf] = idx
+        if instr.opcode == "parameter":
+            alias_sets[instr.name] = frozenset((instr.name,))
+            nbytes[instr.name] = instr.result_bytes
+            born[instr.name] = -1
+            is_arg[instr.name] = True
+            producer[instr.name] = instr
+            continue
+        aliased = (
+            instr.opcode in _ALIAS_OPS or instr.opcode.endswith("-done")
+        )
+        if aliased:
+            merged: frozenset = frozenset()
+            for operand in instr.operands:
+                merged |= alias_sets.get(operand, frozenset())
+            alias_sets[instr.name] = merged
+            continue
+        result_bytes = instr.result_bytes
+        base = frozenset((instr.name,))
+        if instr.opcode.endswith("-start") and len(instr.shapes) > 1:
+            # The async start's tuple head aliases its operand (which
+            # must stay live until the -done); only the final element is
+            # a fresh allocation — same convention as the cost model.
+            result_bytes = instr.shapes[-1][2]
+            for operand in instr.operands:
+                base |= alias_sets.get(operand, frozenset())
+        alias_sets[instr.name] = base
+        nbytes[instr.name] = result_bytes
+        born[instr.name] = idx
+        is_arg[instr.name] = False
+        producer[instr.name] = instr
+
+    # Donation: map each aliased top-level output element to the temp
+    # buffers it resolves to — those write into their parameter buffer.
+    donated_bufs: set = set()
+    donated_params: set = set()
+    root = next((by_name[n] for n in root_names), None)
+    if root is not None and io_alias:
+        elements = (
+            list(root.operands) if root.opcode == "tuple" else [root.name]
+        )
+        for out_idx, p_num in io_alias.items():
+            if 0 <= out_idx < len(elements):
+                donated_bufs |= set(alias_sets.get(elements[out_idx], ()))
+            donated_params.add(p_num)
+    donated_arg_bytes = sum(
+        nbytes[name] for name, num in param_num.items()
+        if num in donated_params
+    )
+
+    def eff_bytes(name: str) -> int:
+        if is_arg.get(name) or name in donated_bufs:
+            return 0
+        return nbytes.get(name, 0)
+
+    births: dict[int, list] = {}
+    deaths: dict[int, list] = {}
+    for name, b in born.items():
+        if is_arg.get(name):
+            continue
+        births.setdefault(b, []).append(name)
+        deaths.setdefault(last_use.get(name, end), []).append(name)
+
+    live = 0
+    live_set: set = set()
+    peak_temp, peak_idx, peak_live = 0, 0, frozenset()
+    for idx in range(end):
+        for name in births.get(idx, ()):
+            live += eff_bytes(name)
+            live_set.add(name)
+        if live > peak_temp:
+            peak_temp, peak_idx = live, idx
+            peak_live = frozenset(live_set)
+        for name in deaths.get(idx, ()):
+            live -= eff_bytes(name)
+            live_set.discard(name)
+
+    argument_bytes = sum(
+        nbytes[name] for name in nbytes if is_arg.get(name)
+    )
+
+    # Saved-for-backward = buffers CARRIED ACROSS the watermark (born
+    # before the peak op, consumed after it). At a train step the peak
+    # sits at the forward/backward boundary — these are exactly the
+    # residuals a remat policy trades for recompute. (HLO metadata no
+    # longer carries the autodiff transpose(...) scopes, so the split
+    # is structural, not name-based.)
+    def carried_across_peak(name: str) -> bool:
+        return (born[name] < peak_idx
+                and last_use.get(name, end) > peak_idx)
+
+    breakdown = {
+        "state": donated_arg_bytes,
+        "batch": argument_bytes - donated_arg_bytes,
+        "saved_activations": 0,
+        "collectives": 0,
+        "temps": 0,
+    }
+    saved = 0
+    for name in peak_live:
+        b = eff_bytes(name)
+        if not b:
+            continue
+        op = producer[name]
+        if _comm_base_kind(op.opcode) is not None:
+            breakdown["collectives"] += b
+        elif carried_across_peak(name):
+            breakdown["saved_activations"] += b
+            saved += b
+        else:
+            breakdown["temps"] += b
+
+    return LivenessResult(
+        peak_bytes=argument_bytes + peak_temp,
+        peak_temp_bytes=peak_temp,
+        peak_index=peak_idx,
+        argument_bytes=argument_bytes,
+        donated_arg_bytes=donated_arg_bytes,
+        undonated_arg_bytes=argument_bytes - donated_arg_bytes,
+        saved_activation_bytes=saved,
+        peak_breakdown=breakdown,
+        n_buffers=len(nbytes),
+    )
+
+
+def _xla_memory(compiled) -> dict:
+    """``memory_analysis()`` distilled: the compiler's own accounting.
+
+    ``peak_bytes`` reconstructs the steady-state footprint the executable
+    allocates: arguments + temps + whatever output bytes are NOT written
+    in place into a donated argument. Missing fields (a backend without
+    memory analysis) return ``None`` values — callers skip rather than
+    invent a reference.
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        stats = None
+    out = {"argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "alias_bytes": None, "peak_bytes": None}
+    if stats is None:
+        return out
+    arg = getattr(stats, "argument_size_in_bytes", None)
+    outp = getattr(stats, "output_size_in_bytes", None)
+    temp = getattr(stats, "temp_size_in_bytes", None)
+    alias = getattr(stats, "alias_size_in_bytes", None)
+    if not all(isinstance(v, int) for v in (arg, outp, temp, alias)):
+        return out
+    out.update(
+        argument_bytes=arg, output_bytes=outp, temp_bytes=temp,
+        alias_bytes=alias,
+        peak_bytes=arg + temp + max(0, outp - alias),
+    )
+    return out
+
+
+@dataclass
+class MemAuditReport:
+    """Findings plus the memory record the budget gate (and BENCH
+    emission) consumes."""
+
+    label: str
+    findings: list = field(default_factory=list)
+    liveness: Optional[LivenessResult] = None
+    record: dict = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _batch_size(batch) -> int:
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape:
+            return int(shape[0])
+    return 0
+
+
+def audit_memory(
+    step_fn: Callable,
+    variables,
+    batch,
+    *,
+    rules=None,
+    mesh_shape: Optional[Mapping[str, int]] = None,
+    mesh=None,
+    data_axes: Tuple[str, ...] = ("data",),
+    device_kind: str = DEFAULT_DEVICE_KIND,
+    donate_argnums: Sequence[int] = (),
+    expects_donation: Optional[bool] = None,
+    coverage_min: float = 0.9,
+    remat_saved_max: int = 0,
+    capacity_bytes: int = 0,
+    recon_floor: float = 0.5,
+    optimizer_slots: int = 0,
+    label: str = "step",
+) -> MemAuditReport:
+    """Audit the compiled memory story of ``step_fn(variables, batch)``.
+
+    The step is AOT-compiled on the fake mesh under ``rules`` (the
+    shard_audit harness, donation included) and the RKT801/802/804/805
+    checks run over the simulated liveness; RKT803 is the CLI's budget
+    gate over the record this returns. ``expects_donation`` defaults to
+    whether anything was donated at all (eval steps pass ``False``
+    explicitly); ``remat_saved_max=0`` disables RKT802 (a target without
+    a remat policy has no declared live-set ceiling);
+    ``capacity_bytes=0`` budgets against the audited device kind's HBM.
+    Pure abstract evaluation + XLA compilation — no FLOPs run, no params
+    materialize, no TPU required.
+    """
+    spec = device_spec(device_kind)
+    if spec is None:
+        raise ValueError(
+            f"mem_audit: unknown device kind {device_kind!r} — add it "
+            "to rocket_tpu.utils.perf.DEVICE_SPECS"
+        )
+    if expects_donation is None:
+        expects_donation = bool(donate_argnums)
+    report = MemAuditReport(label=label)
+    findings: list[Finding] = []
+
+    if mesh is None:
+        mesh = _mesh_from_shape(mesh_shape or {})
+    if rules is None:
+        def rules(path, leaf):  # replicate everything
+            return None
+    abs_variables, abs_batch, specs, placement_findings = resolve_placement(
+        variables, batch, rules=rules, mesh=mesh,
+        data_axes=data_axes, label=label,
+    )
+    # Placement findings are the SPMD auditor's to report; this audit
+    # only needs the placement to compile.
+    del placement_findings
+    compiled, compile_findings = aot_compile_step(
+        step_fn, abs_variables, abs_batch, mesh=mesh,
+        donate_argnums=donate_argnums, label=label,
+    )
+    findings.extend(compile_findings)
+    if compiled is None:
+        report.findings = findings
+        return report
+
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    hlo_text = compiled.as_text()
+    entry, _computations = parse_hlo_module(hlo_text)
+    liveness = simulate_liveness(entry, hlo_text)
+    report.liveness = liveness
+    xla = _xla_memory(compiled)
+
+    # Expected per-device train state: the sharded params plus the
+    # replicated non-param state (resolve_placement replicates those),
+    # times (1 + optimizer_slots) moment trees laid out like the params.
+    params_bytes = sum(
+        _leaf_nbytes(leaf) // max(_shard_factor(s, mesh_sizes), 1)
+        for _path, leaf, s in specs
+    )
+    other_state = 0
+    if isinstance(variables, dict) and "params" in variables:
+        other_state = sum(
+            _leaf_nbytes(leaf)
+            for key, value in variables.items() if key != "params"
+            for leaf in jax.tree_util.tree_leaves(value)
+        )
+    expected_state = (params_bytes + other_state) * (1 + optimizer_slots)
+
+    aliased = xla["alias_bytes"]
+    if aliased is None:
+        aliased = liveness.donated_arg_bytes
+
+    peak = liveness.peak_bytes
+    batch_size = _batch_size(batch)
+    fixed = min(expected_state, peak)
+    dyn = max(0, peak - fixed)
+    frontier: dict[str, int] = {}
+    if batch_size > 0 and dyn > 0:
+        per_batch = dyn / batch_size
+        for kind, dev in sorted(DEVICE_SPECS.items()):
+            frontier[kind] = max(
+                0, int((dev.hbm_bytes - fixed) // per_batch)
+            )
+    capacity = capacity_bytes or spec.hbm_bytes
+
+    findings.extend(check_donation_coverage(
+        aliased, expected_state, expects_donation=expects_donation,
+        coverage_min=coverage_min, label=label,
+    ))
+    findings.extend(check_remat_effectiveness(
+        liveness.saved_activation_bytes, remat_saved_max, label=label,
+    ))
+    findings.extend(check_oom_frontier(
+        peak, capacity, frontier=frontier, batch_size=batch_size,
+        label=label,
+    ))
+    findings.extend(check_reconciliation(
+        peak, xla["peak_bytes"], floor=recon_floor, label=label,
+    ))
+
+    recon = None
+    if xla["peak_bytes"]:
+        recon = round(abs(peak - xla["peak_bytes"]) / xla["peak_bytes"], 4)
+    report.record = {
+        "device_kind": spec.kind,
+        "mesh": mesh_sizes,
+        "batch_size": batch_size,
+        "predicted_peak_bytes": int(peak),
+        "peak_temp_bytes": int(liveness.peak_temp_bytes),
+        "argument_bytes": int(liveness.argument_bytes),
+        "donated_bytes": int(aliased),
+        "undonated_argument_bytes": int(liveness.undonated_arg_bytes),
+        "expected_state_bytes": int(expected_state),
+        "saved_activation_bytes": int(liveness.saved_activation_bytes),
+        "peak_breakdown": {
+            k: int(v) for k, v in liveness.peak_breakdown.items()
+        },
+        "xla_peak_bytes": xla["peak_bytes"],
+        "xla_temp_bytes": xla["temp_bytes"],
+        "reconciliation_error": recon,
+        "oom_frontier": frontier,
+        "capacity_bytes": int(capacity),
+        "n_buffers": int(liveness.n_buffers),
+        "n_ops": len(entry),
+    }
+    report.findings = findings
+    return report
+
+
+# -- builtin targets ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MemTarget:
+    """One self-gate configuration the CLI audits.
+
+    Names pair with the SPMD/schedule audit targets (same model/
+    rule-set/mesh pairings, same fake-mesh compile). ``remat_saved_max``
+    (RKT802) and ``capacity_bytes`` (RKT804) default to disabled /
+    device capacity; ``expects_donation=False`` exempts eval steps from
+    RKT801.
+    """
+
+    name: str
+    mesh_shape: Mapping[str, int]
+    #: () -> (step_fn, variables, batch, rules, donate_argnums)
+    build: Callable[[], tuple]
+    device_kind: str = DEFAULT_DEVICE_KIND
+    expects_donation: bool = True
+    remat_saved_max: int = 0
+    capacity_bytes: int = 0
+    overrides: Mapping[str, Any] = field(default_factory=dict)
+    demo: bool = False
+
+
+def _badmem_parts():
+    """Seeded-bad train step for the true-positive fixture tests: the
+    params are threaded through the update WITHOUT donation (RKT801 —
+    the transient 2x copy), the forward is a long remat-free elementwise
+    activation chain whose every link survives for the backward pass
+    (RKT802 against the target's declared ceiling), and the target's
+    ``capacity_bytes`` is set below the resulting watermark (RKT804)."""
+    import jax.numpy as jnp
+
+    n_layers = 12
+    variables = {
+        "params": {
+            f"w{i}": jax.ShapeDtypeStruct((256, 256), jnp.float32)
+            for i in range(n_layers)
+        },
+        "state": {},
+    }
+    batch = {"x": jax.ShapeDtypeStruct((256, 256), jnp.float32)}
+
+    def loss_fn(params, x):
+        h = x
+        for name in sorted(params):
+            # tanh pins every layer's activation into the saved set —
+            # its VJP needs the output, and nothing is rematerialized.
+            h = jnp.tanh(h @ params[name])
+        return (h * h).mean()
+
+    def bad_step(variables, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            variables["params"], batch["x"]
+        )
+        params = jax.tree.map(
+            lambda p, g: p - 1e-3 * g, variables["params"], grads
+        )
+        return {"params": params, "state": variables["state"]}, loss
+
+    # donate_argnums=() — the seeded RKT801: state threaded, not donated.
+    return bad_step, variables, batch, None, ()
+
+
+def _mem_builder(name):
+    def build():
+        import rocket_tpu.analysis.sched_audit as sched_audit
+
+        return getattr(sched_audit, name)()
+    return build
+
+
+#: name -> target. The default sweep runs the non-demo entries — the
+#: same five train/eval pairings the SPMD and schedule audits gate.
+MEM_TARGETS: dict[str, MemTarget] = {}
+
+
+def _register_targets():
+    for target in (
+        MemTarget(
+            name="tp_1x8",
+            mesh_shape={"data": 1, "model": 8},
+            build=_mem_builder("_tp_sched_parts"),
+        ),
+        MemTarget(
+            name="tp_2x4",
+            mesh_shape={"data": 2, "model": 4},
+            build=_mem_builder("_tp_2x4_sched_parts"),
+        ),
+        MemTarget(
+            name="tp_2x4_eval",
+            mesh_shape={"data": 2, "model": 4},
+            build=_mem_builder("_tp_eval_sched_parts"),
+            expects_donation=False,
+        ),
+        MemTarget(
+            name="fsdp_1x8",
+            mesh_shape={"data": 8},
+            build=_mem_builder("_fsdp_sched_parts"),
+        ),
+        MemTarget(
+            name="dp_resnet_1x8",
+            mesh_shape={"data": 8},
+            build=_mem_builder("_resnet_parts"),
+        ),
+        MemTarget(
+            name="badmem",
+            mesh_shape={"data": 1},
+            build=_badmem_parts,
+            # The chain saves ~12 x 256x256 f32 activations (~3 MiB);
+            # a declared 64 KiB remat ceiling makes RKT802 undeniable.
+            remat_saved_max=1 << 16,
+            # Capacity below the watermark: RKT804's seeded OOM.
+            capacity_bytes=2 << 20,
+            demo=True,
+        ),
+    ):
+        MEM_TARGETS[target.name] = target
+
+
+_register_targets()
+
+
+def run_mem_target(target: MemTarget) -> MemAuditReport:
+    step_fn, variables, batch, rules, donate = target.build()
+    return audit_memory(
+        step_fn, variables, batch,
+        rules=rules, mesh_shape=target.mesh_shape,
+        device_kind=target.device_kind, donate_argnums=donate,
+        expects_donation=target.expects_donation,
+        remat_saved_max=target.remat_saved_max,
+        capacity_bytes=target.capacity_bytes, label=target.name,
+        **dict(target.overrides),
+    )

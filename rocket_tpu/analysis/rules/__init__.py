@@ -27,8 +27,10 @@ from rocket_tpu.analysis.rules.host_rules import (
 from rocket_tpu.analysis.rules.jit_rules import (
     JitSideEffectRule,
     TracerLeakRule,
+    UndonatedJitStateRule,
 )
 from rocket_tpu.analysis.rules.calib_rules import CALIB_RULES
+from rocket_tpu.analysis.rules.mem_rules import MEM_RULES
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
 from rocket_tpu.analysis.rules.race_rules import UnlockedMutationRule
 from rocket_tpu.analysis.rules.retry_rules import SwallowedInterruptRule
@@ -37,7 +39,8 @@ from rocket_tpu.analysis.rules.serve_rules import SERVE_RULES
 from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
 __all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
-           "SCHED_RULES", "SERVE_RULES", "CALIB_RULES", "all_rules"]
+           "SCHED_RULES", "SERVE_RULES", "CALIB_RULES", "MEM_RULES",
+           "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -51,6 +54,7 @@ AST_RULES = (
     StringDtypeRule(),
     UnlockedMutationRule(),
     SwallowedInterruptRule(),
+    UndonatedJitStateRule(),
 )
 
 #: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.
@@ -79,10 +83,11 @@ AUDIT_RULES = (
 def all_rules():
     """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
     (RKT2xx), SPMD audit (RKT3xx), precision audit (RKT4xx), schedule
-    audit (RKT5xx), serving audit (RKT6xx) and calibration audit
-    (RKT7xx) — in id order."""
+    audit (RKT5xx), serving audit (RKT6xx), calibration audit (RKT7xx)
+    and memory audit (RKT8xx) — in id order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
     return tuple(sorted(
         ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
         + list(SCHED_RULES) + list(SERVE_RULES) + list(CALIB_RULES)
+        + list(MEM_RULES)
     ))

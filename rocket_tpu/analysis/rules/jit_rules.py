@@ -13,7 +13,7 @@ from typing import Iterable
 
 from rocket_tpu.analysis.findings import Finding
 
-__all__ = ["TracerLeakRule", "JitSideEffectRule"]
+__all__ = ["TracerLeakRule", "JitSideEffectRule", "UndonatedJitStateRule"]
 
 
 def _call_name(node: ast.AST):
@@ -109,3 +109,142 @@ class JitSideEffectRule:
                     "ONCE at trace time and becomes a constant; thread a "
                     "jax.random key instead",
                 )
+
+
+#: First-parameter names that mark a step as *state-threading*: the
+#: function receives the recurrent train/optimizer state and returns its
+#: successor every call.
+_STATE_PARAMS = frozenset({
+    "state", "variables", "params", "opt_state", "train_state", "carry",
+})
+
+_JIT_NAMES = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+
+
+class UndonatedJitStateRule:
+    rule_id = "RKT111"
+    slug = "undonated-jit-state"
+    contract = (
+        "a jax.jit'ed step threads recurrent state (first parameter named "
+        "state/variables/params/opt_state/train_state/carry, with its "
+        "successor returned as the first element of the result tuple) "
+        "without donate_argnums/donate: every call pays a transient 2x "
+        "copy of the state instead of updating the buffers in place"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        defs = {
+            node.name: node
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # Call form: self._step = jax.jit(train_step)  (no donate kwarg)
+        for call in ctx.walk_calls():
+            if _call_name(call.func) not in _JIT_NAMES:
+                continue
+            if any(kw.arg and kw.arg.startswith("donate")
+                   for kw in call.keywords):
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            fn = defs.get(call.args[0].id)
+            state = self._threaded_state(fn) if fn is not None else None
+            if state:
+                yield self._finding(ctx, call.lineno, fn.name, state)
+        # Decorator form: @jax.jit / @partial(jax.jit) with no donate.
+        for fn in defs.values():
+            if self._jit_decorator_donates(fn) is False:
+                state = self._threaded_state(fn)
+                if state:
+                    yield self._finding(ctx, fn.lineno, fn.name, state)
+
+    def _finding(self, ctx, lineno: int, fn_name: str, state: str) -> Finding:
+        return Finding(
+            self.rule_id, ctx.path, lineno,
+            f"jit({fn_name}) threads `{state}` through the step without "
+            "donation: the old state stays live while the new one is "
+            "written — a transient 2x copy every call; pass "
+            "donate_argnums=(0,) (and return every donated leaf)",
+        )
+
+    @staticmethod
+    def _jit_decorator_donates(fn):
+        """None if ``fn`` has no jit decorator, else whether any jit
+        decorator carries a donate kwarg."""
+        for deco in fn.decorator_list:
+            if _call_name(deco) in _JIT_NAMES:
+                return False  # bare @jax.jit — nothing donated
+            if not isinstance(deco, ast.Call):
+                continue
+            name = _call_name(deco.func)
+            is_jit = name in _JIT_NAMES or (
+                name in ("partial", "functools.partial")
+                and deco.args and _call_name(deco.args[0]) in _JIT_NAMES
+            )
+            if is_jit:
+                return any(
+                    kw.arg and kw.arg.startswith("donate")
+                    for kw in deco.keywords
+                )
+        return None
+
+    @staticmethod
+    def _threaded_state(fn):
+        """The state parameter's name when ``fn`` threads it, else None.
+
+        Threads = first parameter is state-named AND some return's first
+        tuple element derives from it (a bounded taint walk over the
+        assignments — `new_state = update(state); return new_state, loss`
+        resolves). A single non-tuple return (an eval step yielding
+        logits) is a transform, not a threading loop, and is not
+        flagged. Nested defs (fori_loop/scan bodies) are their own
+        scope: their returns are loop carries, not the jitted step's
+        output, so the walk stays in ``fn``'s own frame.
+        """
+        arg_names = [
+            a.arg for a in (fn.args.posonlyargs + fn.args.args)
+        ]
+        if arg_names and arg_names[0] in ("self", "cls"):
+            arg_names = arg_names[1:]
+        if not arg_names or arg_names[0] not in _STATE_PARAMS:
+            return None
+        state = arg_names[0]
+
+        def own_nodes(root):
+            """ast.walk limited to ``root``'s frame — does not descend
+            into nested function definitions or lambdas."""
+            stack = list(ast.iter_child_nodes(root))
+            while stack:
+                node = stack.pop()
+                yield node
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.extend(ast.iter_child_nodes(node))
+
+        def mentions(node, names) -> bool:
+            return any(
+                isinstance(n, ast.Name) and n.id in names
+                for n in ast.walk(node)
+            )
+
+        tainted = {state}
+        changed = True
+        while changed:
+            changed = False
+            for node in own_nodes(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not mentions(node.value, tainted):
+                    continue
+                for target in node.targets:
+                    for n in ast.walk(target):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+        for node in own_nodes(fn):
+            if (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Tuple)
+                    and node.value.elts
+                    and mentions(node.value.elts[0], tainted)):
+                return state
+        return None

@@ -1,0 +1,187 @@
+"""Memory audit rules (``RKT8xx``) — checks over the simulated HBM
+liveness of a compiled train step.
+
+The schedule auditor (RKT5xx) prices the compiled step's *time*; this
+family prices its *space*: buffer liveness is simulated over the
+as-compiled op order (scheduled HLO text order IS the schedule), giving
+per-op live sets and a peak-HBM watermark attributed into params /
+optimizer state / saved-for-backward activations / collective buffers /
+temps. The checks then ask the questions an OOM answers after burning a
+hardware run — is the whole train state donated through the update, did
+the remat policy actually shrink the saved-activation set, what batch
+still fits each device kind — before any run, on the same fake-mesh
+AOT compile the SPMD/schedule audits use.
+
+The liveness simulation, attribution and builtin targets live in
+:mod:`rocket_tpu.analysis.mem_audit`; this module holds the catalog
+plus the fact->Finding checks, so the rule logic is testable without
+compiling anything.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "MEM_RULES",
+    "check_donation_coverage",
+    "check_remat_effectiveness",
+    "check_oom_frontier",
+    "check_reconciliation",
+]
+
+#: (id, slug, contract) — the catalog, same shape as SCHED_RULES.
+MEM_RULES = (
+    ("RKT801", "undonated-train-state",
+     "the train step's donation-aliased bytes do not cover the params + "
+     "optimizer state through the update (the training analogue of the "
+     "serving pool proof, RKT604): every undonated state buffer is a "
+     "transient 2x copy at the step boundary — donate the state argument"),
+    ("RKT802", "remat-ineffective",
+     "the saved-for-backward activation bytes (buffers live across the "
+     "forward/backward boundary) exceed the target's declared remat "
+     "policy ceiling: the checkpointing policy is not actually shrinking "
+     "the live set the backward pass holds"),
+    ("RKT803", "mem-budget-regression",
+     "the simulated peak HBM or saved-activation bytes grew more than "
+     "the tolerance over the checked-in memory budget file"),
+    ("RKT804", "oom-frontier",
+     "the simulated peak HBM does not fit the audited device kind's "
+     "capacity: the step OOMs before it runs — the finding carries the "
+     "max batch that still fits each known device kind"),
+    ("RKT805", "liveness-divergence",
+     "the simulated peak diverged from the compiler's own "
+     "memory_analysis() beyond the reconciliation floor: the parser or "
+     "the liveness model is mispricing this module — fix the model, do "
+     "not trust its numbers"),
+)
+
+
+def _mem_path(label: str) -> str:
+    return f"<mem:{label}>"
+
+
+def _mib(nbytes: float) -> str:
+    return f"{nbytes / 2**20:.1f} MiB"
+
+
+def check_donation_coverage(
+    aliased_bytes: int,
+    expected_state_bytes: int,
+    *,
+    expects_donation: bool = True,
+    coverage_min: float = 0.9,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT801: donation-aliased bytes must cover the train state.
+
+    ``aliased_bytes`` is what the compiled executable actually aliases
+    input->output (``memory_analysis().alias_size_in_bytes`` — the
+    compiler's own proof that the update happens in place);
+    ``expected_state_bytes`` is the per-device params + optimizer state
+    the step threads through. Eval steps (``expects_donation=False``)
+    return no new state and are exempt.
+    """
+    if not expects_donation or expected_state_bytes <= 0:
+        return []
+    if aliased_bytes >= coverage_min * expected_state_bytes:
+        return []
+    return [Finding(
+        "RKT801", _mem_path(label), 0,
+        f"undonated-train-state: the compiled step aliases only "
+        f"{_mib(aliased_bytes)} of the {_mib(expected_state_bytes)} "
+        f"per-device train state through the update "
+        f"(coverage {aliased_bytes / expected_state_bytes * 100:.0f}% < "
+        f"{coverage_min * 100:.0f}%) — every undonated buffer is a "
+        "transient 2x copy at the step boundary; pass the state through "
+        "donate_argnums (and return every donated leaf)",
+    )]
+
+
+def check_remat_effectiveness(
+    saved_activation_bytes: int,
+    saved_max_bytes: int,
+    *,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT802: saved-for-backward bytes vs the declared remat ceiling.
+
+    ``saved_max_bytes`` is the target's declared prediction of what its
+    checkpointing policy should leave live across the forward/backward
+    boundary (0 disables — a target without a remat policy has nothing
+    to hold the saved set against).
+    """
+    if saved_max_bytes <= 0 or saved_activation_bytes <= saved_max_bytes:
+        return []
+    return [Finding(
+        "RKT802", _mem_path(label), 0,
+        f"remat-ineffective: {_mib(saved_activation_bytes)} of "
+        f"activations survive the forward pass for the backward "
+        f"(declared remat ceiling {_mib(saved_max_bytes)}) — the "
+        "checkpointing policy is not shrinking the live set; remat the "
+        "block boundaries or re-declare the ceiling if the policy "
+        "changed intentionally",
+    )]
+
+
+def check_oom_frontier(
+    peak_bytes: int,
+    capacity_bytes: int,
+    *,
+    frontier: Optional[Mapping[str, int]] = None,
+    batch_size: int = 0,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT804: the simulated peak must fit the audited device's HBM.
+
+    ``frontier`` maps device kind -> max batch that still fits (the
+    report ROADMAP item 3's SSD family will use to demonstrate a
+    frontier flat in sequence length); it rides in the finding so the
+    fix — drop the batch to the number printed — needs no re-audit.
+    """
+    if capacity_bytes <= 0 or peak_bytes <= capacity_bytes:
+        return []
+    fits = ", ".join(
+        f"{kind}: batch<={mb}" for kind, mb in sorted((frontier or {}).items())
+    )
+    at = f" at batch {batch_size}" if batch_size else ""
+    return [Finding(
+        "RKT804", _mem_path(label), 0,
+        f"oom-frontier: simulated peak {_mib(peak_bytes)}{at} exceeds "
+        f"the {_mib(capacity_bytes)} device capacity — the step OOMs "
+        f"before it runs; max batch per device kind: {fits or 'none'}",
+    )]
+
+
+def check_reconciliation(
+    simulated_peak_bytes: int,
+    xla_peak_bytes: Optional[int],
+    *,
+    floor: float = 0.5,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT805: the liveness simulation vs the compiler's own accounting.
+
+    ``xla_peak_bytes`` is reconstructed from ``memory_analysis()``
+    (arguments + temps + unaliased outputs). A divergence beyond
+    ``floor`` means the parser or the liveness model is mispricing this
+    module — that must fail loudly, because every other RKT80x number
+    derives from the simulated peak. ``None`` (backend without memory
+    analysis) skips the check rather than inventing a reference.
+    """
+    if xla_peak_bytes is None or xla_peak_bytes <= 0 or floor <= 0:
+        return []
+    error = abs(simulated_peak_bytes - xla_peak_bytes) / xla_peak_bytes
+    if error <= floor:
+        return []
+    return [Finding(
+        "RKT805", _mem_path(label), 0,
+        f"liveness-divergence: simulated peak "
+        f"{_mib(simulated_peak_bytes)} vs the compiler's own "
+        f"{_mib(xla_peak_bytes)} (error {error * 100:.0f}% > floor "
+        f"{floor * 100:.0f}%) — the HLO parser or the liveness model is "
+        "mispricing this module; fix the model before trusting any "
+        "RKT80x number it produced",
+    )]

@@ -359,6 +359,7 @@ class OpCost:
     is_comm: bool
     operands: Tuple[str, ...]
     where: str = ""
+    is_dcn: bool = False  # replica group spans a slice boundary (DCN-priced)
 
     @property
     def intensity(self) -> float:
@@ -385,10 +386,41 @@ def _group_size(instr: HloInstr) -> int:
     return 1
 
 
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+def _crosses_slice(instr: HloInstr, slice_size: int) -> bool:
+    """Whether the collective's communicating devices span a slice
+    boundary on a slice-major fake mesh (device ``d`` lives on slice
+    ``d // slice_size``). List-form replica groups are checked member by
+    member; iota-form groups are contiguous-major, so a group wider than
+    a slice must cross; a collective-permute crosses when any
+    source/target pair does."""
+    if slice_size <= 0:
+        return False
+    grp = _GROUPS_LIST_RE.search(instr.attrs)
+    if grp is not None:
+        members = [int(m) for m in grp.group(1).split(",")]
+        return len({m // slice_size for m in members}) > 1
+    grp = _GROUPS_IOTA_RE.search(instr.attrs)
+    if grp is not None:
+        return int(grp.group(2)) > slice_size
+    pairs = _PAIRS_RE.search(instr.attrs)
+    if pairs is not None:
+        return any(
+            int(a) // slice_size != int(b) // slice_size
+            for a, b in _PAIR_RE.findall(pairs.group(1))
+        )
+    return False
+
+
 def cost_ops(
     entry: Sequence[HloInstr],
     computations: Mapping[str, list[HloInstr]],
     spec: DeviceSpec,
+    *,
+    slice_size: int = 0,
 ) -> list[OpCost]:
     """Roofline-cost every scheduled op of the entry computation.
 
@@ -399,6 +431,13 @@ def cost_ops(
     join markers so sync and async forms of one op cost the same. FLOPs
     inside fusions/calls come from their called computations (dots and
     convolutions found recursively).
+
+    ``slice_size`` > 0 declares a multi-slice topology (``slice_size``
+    devices per ICI domain, slice-major device order): any collective
+    whose replica group spans a slice boundary is priced at the
+    data-center network column ``spec.dcn_bw`` instead of ``ici_bw`` —
+    cross-slice bytes are 10-40x slower per the spec table, which is
+    the whole reason the audit has to see them.
     """
     memo: dict = {}
     computations = dict(computations)
@@ -441,18 +480,23 @@ def cost_ops(
             # explicit collective-permute hop moves its chunk over ONE
             # link — priced hop-by-hop at the per-link column, which is
             # what makes a ppermute ring honest against a bulk
-            # all-gather of the same bytes.
-            bw = (
-                spec.ici_link_bw
-                if comm_kind == "collective-permute"
-                else spec.ici_bw
-            )
+            # all-gather of the same bytes. A group that spans a slice
+            # boundary leaves ICI entirely: the slowest hop (DCN) sets
+            # the collective's rate.
+            dcn = _crosses_slice(instr, slice_size)
+            if dcn:
+                bw = spec.dcn_bw
+            elif comm_kind == "collective-permute":
+                bw = spec.ici_link_bw
+            else:
+                bw = spec.ici_bw
             time_s = bytes_moved / bw + COLLECTIVE_LATENCY_S
             ops.append(OpCost(
                 name=instr.name, opcode=instr.opcode, kind="comm",
                 time_s=time_s, flops=0.0, hbm_bytes=hbm_bytes,
                 comm_bytes=bytes_moved, is_comm=True,
                 operands=instr.operands, where=instr.where,
+                is_dcn=dcn,
             ))
             continue
 
@@ -819,13 +863,16 @@ def collect_pallas_facts(step_fn: Callable, variables, batch) -> list:
 def predict_compiled(
     hlo_text: str,
     device_kind: str = DEFAULT_DEVICE_KIND,
+    slice_size: int = 0,
 ) -> tuple[SimResult, SimResult, dict]:
     """Roofline-simulate an optimized HLO dump for ``device_kind``.
 
     Returns ``(scheduled, ideal, record)``: the as-compiled simulation,
     the ideal-overlap simulation, and the budget/BENCH record. Raises
     ``ValueError`` for an unknown device kind (price against a known
-    machine or not at all).
+    machine or not at all). ``slice_size`` > 0 prices cross-slice
+    collectives at DCN bandwidth (see :func:`cost_ops`) and adds
+    ``n_dcn_collectives`` / ``dcn_bytes_per_step`` to the record.
     """
     spec = device_spec(device_kind)
     if spec is None:
@@ -834,7 +881,7 @@ def predict_compiled(
             "to rocket_tpu.utils.perf.DEVICE_SPECS"
         )
     entry, computations = parse_hlo_module(hlo_text)
-    ops = cost_ops(entry, computations, spec)
+    ops = cost_ops(entry, computations, spec, slice_size=slice_size)
     scheduled = simulate(ops, overlap=False)
     ideal = simulate(ops, overlap=True)
 
@@ -880,6 +927,15 @@ def predict_compiled(
             if op.is_comm and not op.opcode.endswith("-done")
         ]),
     }
+    if slice_size > 0:
+        dcn_ops = [
+            op for op in ops
+            if op.is_dcn and not op.opcode.endswith("-done")
+        ]
+        record["n_dcn_collectives"] = len(dcn_ops)
+        record["dcn_bytes_per_step"] = int(
+            sum(op.comm_bytes for op in dcn_ops)
+        )
     return scheduled, ideal, record
 
 
@@ -919,6 +975,7 @@ def audit_schedule(
     bucket_bytes: int = 4 << 20,
     memory_frac_max: float = 0.6,
     memory_min_bytes: int = 1 << 20,
+    slice_size: int = 0,
     label: str = "step",
 ) -> SchedAuditReport:
     """Audit the compiled schedule of ``step_fn(variables, batch)``.
@@ -975,7 +1032,7 @@ def audit_schedule(
         findings.extend(compile_findings)
         if compiled is not None:
             scheduled, ideal, record = predict_compiled(
-                compiled.as_text(), device_kind
+                compiled.as_text(), device_kind, slice_size=slice_size
             )
             report.scheduled, report.ideal = scheduled, ideal
             report.record = dict(record, mesh=dict(
@@ -1049,6 +1106,19 @@ def _fsdp_sched_parts():
     from rocket_tpu.analysis.shard_audit import _fsdp_parts
 
     return _fsdp_parts()
+
+
+def _dp_2slice_parts():
+    """Two-slice data parallelism: params FSDP-sharded inside each
+    slice, batch split across both mesh axes. The gradient reduction
+    then factors into an intra-slice reduce-scatter (ICI) and a
+    cross-slice all-reduce whose replica groups span the slice boundary
+    — the target's ``slice_size`` override makes the cost model price
+    those at ``DeviceSpec.dcn_bw``. Plain GSPMD step (no overlap
+    machinery): the point here is the DCN pricing, not the overlap."""
+    from rocket_tpu.parallel.sharding import fsdp_rules
+
+    return _lm_parts(fsdp_rules(axis="data", min_size=4096))
 
 
 def _resnet_parts(batch_size: int = 64):
@@ -1353,6 +1423,20 @@ def _register_targets():
             mesh_shape={"data": 8},
             build=_fsdp_sched_parts,
             mfu_floor=0.012,
+        ),
+        SchedTarget(
+            name="dp_2slice",
+            mesh_shape={"slice": 2, "data": 4},
+            build=_dp_2slice_parts,
+            # Cross-slice gradient all-reduce at DCN bandwidth dominates
+            # the predicted step; measured predicted_mfu 0.0143 — the
+            # floor sits under it with the usual headroom.
+            mfu_floor=0.009,
+            overrides={"data_axes": ("slice", "data"), "slice_size": 4,
+                       # DCN exposure is structural for an unoverlapped
+                       # 2-slice program: the exposed_comm_us budget
+                       # tracks it; RKT501 gates only gross regressions.
+                       "exposed_frac_min": 0.9},
         ),
         SchedTarget(
             name="tp_2x4_eval",

@@ -89,6 +89,10 @@ def _report_telemetry(doc: dict) -> str:
     if prof:
         lines.append("")
         lines.append(prof)
+    hbm = _render_hbm(metrics)
+    if hbm:
+        lines.append("")
+        lines.append(hbm)
     watchdog = doc.get("watchdog", {})
     if watchdog.get("enabled"):
         lines.append(
@@ -138,6 +142,46 @@ def _render_prof_gauges(metrics: dict) -> str:
                 f"{cat}={value:.1%}" for cat, value in sorted(fracs.items())
             )
         )
+    return "\n".join(lines)
+
+
+def _render_hbm(metrics: dict) -> str:
+    """The HBM watermark section: the measured device-memory gauges
+    (``registry.record_device_memory``), with the memory auditor's
+    committed predicted peaks alongside when the budget files are
+    reachable from the working directory — measured-vs-predicted at a
+    glance, same pairing the calibration audit formalizes for time.
+    Empty string when the backend never reported memory stats."""
+    gauges = metrics.get("gauges", {})
+    watermarks = [
+        (name, gauges[name])
+        for name in ("hbm/bytes_in_use_max", "hbm/peak_bytes_in_use_max")
+        if isinstance(gauges.get(name), (int, float))
+    ]
+    if not watermarks:
+        return ""
+    gib = 1 << 30
+    lines = ["hbm watermarks (max over local devices):"]
+    for name, value in watermarks:
+        lines.append(f"  {name:<36} {value / gib:.3f} GiB")
+    try:
+        from rocket_tpu.analysis.budgets import MEM_DIR, load_budget
+
+        targets = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(MEM_DIR)
+            if f.endswith(".json")
+        )
+    except OSError:
+        targets = []
+    predicted = []
+    for target in targets:
+        budget = load_budget(MEM_DIR, target) or {}
+        peak = budget.get("predicted_peak_bytes")
+        if isinstance(peak, (int, float)):
+            predicted.append(f"  {target:<36} {peak / gib:.3f} GiB")
+    if predicted:
+        lines.append("predicted peaks (mem audit budgets, per device):")
+        lines.extend(predicted)
     return "\n".join(lines)
 
 

@@ -6,7 +6,8 @@
 # self-gate + numerics budgets) + schedule audit + calibration audit
 # (live device-trace capture reconciled against the priced HLO DAG +
 # drift budgets) + serving audit (retrace-surface/latency/HBM
-# self-gate + serving budgets) + obs telemetry smoke + resilience
+# self-gate + serving budgets) + memory audit (HBM liveness self-gate
+# + peak budgets) + obs telemetry smoke + resilience
 # smoke (supervised restart / drain) + the tier-1 test suite (command
 # from ROADMAP.md). Exits non-zero on the first failing stage.
 set -euo pipefail
@@ -110,6 +111,32 @@ echo "== serving audit (retrace-surface / latency-roofline / HBM-fit self-gate +
 # TTFT/HBM regression over tests/fixtures/budgets/serve/.
 JAX_PLATFORMS=cpu python -m rocket_tpu.analysis serve \
     --budgets tests/fixtures/budgets/serve
+
+echo "== memory audit (HBM liveness self-gate + peak budgets) =="
+# Replays each AOT-compiled train/eval step's scheduled HLO as a buffer
+# liveness simulation (donation-aware); fails on memory findings
+# (RKT801/802/804/805: undonated state, ineffective remat, OOM
+# frontier, liveness-vs-memory_analysis divergence) or a >10%
+# predicted-peak / saved-activation regression over
+# tests/fixtures/budgets/mem/.
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis mem \
+    --budgets tests/fixtures/budgets/mem
+
+echo "== memory true-positive (seeded-bad badmem demo) =="
+# The memory rules must still FIND the failure they were built to
+# kill: the undonated, remat-free long-chain demo must report exactly
+# the seeded set - RKT801 (undonated state), RKT802 (remat
+# ineffective) and RKT804 (over the seeded 2 MiB capacity).
+if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis mem \
+        --target badmem --format json >/tmp/_badmem.json 2>&1; then
+    echo "badmem demo reported no findings - rules are broken"
+    exit 1
+fi
+python - <<'PY' || { echo "badmem demo rule set drifted:"; cat /tmp/_badmem.json; exit 1; }
+import json
+rules = {f["rule"] for f in json.load(open("/tmp/_badmem.json"))}
+assert rules == {"RKT801", "RKT802", "RKT804"}, rules
+PY
 
 echo "== obs smoke (telemetry + health sentinels + strict step path) =="
 # Tier-1 example run with telemetry AND health sentinels on:

@@ -1,0 +1,25 @@
+"""Seeded RKT111 violations: jit-wrapped steps threading recurrent
+state with no donation — the old state stays live while the new one is
+written, a transient 2x copy every call."""
+
+import jax
+
+
+def train_step(state, batch):
+    new_params = jax.tree.map(lambda p: p - 0.1, state["params"])
+    new_state = {"params": new_params, "step": state["step"] + 1}
+    return new_state, batch.sum()
+
+
+# Violation 1 (call form): the canonical train loop wiring, minus the
+# donate_argnums that makes the update in-place.
+step = jax.jit(train_step)
+
+
+# Violation 2 (decorator form): an optimizer update threading its
+# moment tree through a bare @jax.jit.
+@jax.jit
+def opt_update(opt_state, grads):
+    mu = jax.tree.map(lambda m, g: 0.9 * m + g, opt_state["mu"], grads)
+    out = {"mu": mu, "count": opt_state["count"] + 1}
+    return out, grads

@@ -1,0 +1,33 @@
+"""Clean counterparts for RKT111: donated state threads, and an eval
+transform that returns a value (not a successor state) and so is not a
+threading loop at all."""
+
+from functools import partial
+
+import jax
+
+
+def train_step(state, batch):
+    new_params = jax.tree.map(lambda p: p - 0.1, state["params"])
+    return {"params": new_params}, batch.sum()
+
+
+# Donated call form: the update happens in place.
+step = jax.jit(train_step, donate_argnums=(0,))
+
+
+# Donated decorator form.
+@partial(jax.jit, donate_argnums=(0,))
+def opt_update(opt_state, grads):
+    mu = jax.tree.map(lambda m, g: 0.9 * m + g, opt_state["mu"], grads)
+    return {"mu": mu}, grads
+
+
+def eval_step(params, batch):
+    logits = batch @ params["w"]
+    return logits
+
+
+# An eval transform returns logits, not a successor state — no donation
+# expected, no finding.
+evaluate = jax.jit(eval_step)

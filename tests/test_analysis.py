@@ -46,6 +46,7 @@ AST_CASES = [
     ("RKT108", "string_dtype"),
     ("RKT109", "unlocked_mutation"),
     ("RKT110", "swallowed_interrupt"),
+    ("RKT111", "undonated_jit_state"),
 ]
 
 

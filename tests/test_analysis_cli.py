@@ -1,9 +1,11 @@
-"""The analysis CLI process contract, for all five entry forms.
+"""The analysis CLI process contract, for every entry form.
 
 ``python -m rocket_tpu.analysis`` (rocketlint over paths), ``... shard``
 (the SPMD auditor), ``... prec`` (the dtype-flow auditor), ``... sched``
-(the roofline/schedule auditor) and ``... serve`` (the serving-path
-auditor) must hold the same machine contract CI scripts depend on: exit
+(the roofline/schedule auditor), ``... serve`` (the serving-path
+auditor), ``... calib`` (measured-vs-predicted calibration) and
+``... mem`` (the HBM liveness auditor) must hold the same machine
+contract CI scripts depend on: exit
 0 on a clean tree, 1 on findings, 2 on usage errors, and one
 ``--format json`` output shape. The audit
 subcommands share one registry (``__main__.AUDIT_SUBCOMMANDS``), so the
@@ -58,12 +60,13 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_seven_families():
+def test_list_rules_includes_all_eight_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
-    for rule_id in ("RKT101", "RKT108", "RKT109", "RKT201", "RKT301",
-                    "RKT306", "RKT401", "RKT406", "RKT501", "RKT506",
-                    "RKT601", "RKT606", "RKT701", "RKT703"):
+    for rule_id in ("RKT101", "RKT108", "RKT109", "RKT111", "RKT201",
+                    "RKT301", "RKT306", "RKT401", "RKT406", "RKT501",
+                    "RKT506", "RKT601", "RKT606", "RKT701", "RKT703",
+                    "RKT801", "RKT805"):
         assert rule_id in proc.stdout
 
 
@@ -75,15 +78,62 @@ def test_audit_registry_covers_every_subcommand():
     from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
 
     assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve",
-                                      "calib"}
+                                      "calib", "mem"}
 
 
 @pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve",
-                                 "calib"])
+                                 "calib", "mem"])
 def test_every_audit_subcommand_holds_the_usage_contract(sub):
     assert run_cli(sub, "--target", "nope").returncode == 2
     assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
     assert run_cli(sub, "--list-targets").returncode == 0
+
+
+# -- seeded-bad demos: exact rule sets ---------------------------------------
+
+#: (subcommand, demo target) -> the EXACT finding set the seeded
+#: defects produce. Exact, not superset: a demo that starts firing an
+#: extra rule has either grown a new defect or broken a rule's
+#: precision, and both deserve a red test. One row per demo target in
+#: every audit registry — completeness is enforced below.
+DEMO_EXPECTED = {
+    ("shard", "badrules"): {"RKT301", "RKT304", "RKT305"},
+    ("prec", "badprec"): {"RKT401", "RKT402", "RKT403", "RKT404",
+                          "RKT405"},
+    ("sched", "badsched"): {"RKT501", "RKT502", "RKT503", "RKT505"},
+    ("sched", "badoverlap"): {"RKT501", "RKT502", "RKT503"},
+    ("sched", "badpallas"): {"RKT504"},
+    ("serve", "badserve"): {"RKT601", "RKT602", "RKT603", "RKT604",
+                            "RKT605"},
+    ("mem", "badmem"): {"RKT801", "RKT802", "RKT804"},
+}
+
+
+def test_every_demo_target_has_a_pinned_rule_set():
+    """Every demo target in every audit registry must carry a
+    DEMO_EXPECTED row — a new seeded-bad fixture without a pinned set
+    is a true-positive test that silently doesn't exist."""
+    from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
+
+    demos = set()
+    for sub, cli in AUDIT_SUBCOMMANDS.items():
+        targets, _run = cli.load()
+        for name, target in targets.items():
+            if getattr(target, "demo", False):
+                demos.add((sub, name))
+    assert demos == set(DEMO_EXPECTED)
+
+
+@pytest.mark.parametrize("sub,target", sorted(DEMO_EXPECTED))
+def test_demo_target_fails_with_exactly_the_seeded_rules(sub, target):
+    """True positives through the real CLI: each seeded-bad demo must
+    exit 1 with exactly its seeded finding families, in the shared JSON
+    shape."""
+    proc = run_cli(sub, "--target", target, "--format", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    findings = json.loads(proc.stdout)
+    assert set(findings[0]) == {"rule", "path", "line", "message"}
+    assert {f["rule"] for f in findings} == DEMO_EXPECTED[(sub, target)]
 
 
 # -- shard form --------------------------------------------------------------
@@ -124,14 +174,8 @@ def test_shard_self_provisions_platform_without_env():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_shard_badrules_reports_dead_replicated_excess():
-    """True positives through the real CLI: the seeded-bad rule set must
-    surface all three finding families, exit 1, in the shared JSON
-    shape."""
-    proc = run_cli("shard", "--target", "badrules", "--format", "json")
-    assert proc.returncode == 1
-    rules = {f["rule"] for f in json.loads(proc.stdout)}
-    assert {"RKT301", "RKT304", "RKT305"} <= rules
+# (Seeded-bad true positives for every family run in the DEMO_EXPECTED
+# meta-test above.)
 
 
 # -- prec form ---------------------------------------------------------------
@@ -157,17 +201,6 @@ def test_prec_self_gate_is_clean_and_budgets_hold():
     proc = run_cli("prec", "--budgets",
                    os.path.join("tests", "fixtures", "budgets", "prec"))
     assert proc.returncode == 0, proc.stdout + proc.stderr
-
-
-def test_prec_badprec_reports_all_five_rules():
-    """True positives through the real CLI: the seeded-bad step must
-    surface every RKT40x family, exit 1, in the shared JSON shape."""
-    proc = run_cli("prec", "--target", "badprec", "--format", "json")
-    assert proc.returncode == 1
-    findings = json.loads(proc.stdout)
-    assert set(findings[0]) == {"rule", "path", "line", "message"}
-    rules = {f["rule"] for f in findings}
-    assert rules == {"RKT401", "RKT402", "RKT403", "RKT404", "RKT405"}
 
 
 @pytest.mark.slow
@@ -236,8 +269,8 @@ def test_sched_list_targets():
     proc = run_cli("sched", "--list-targets")
     assert proc.returncode == 0
     for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "dp_resnet_1x8",
-                 "tp_flash", "fused_kernels", "badsched", "badoverlap",
-                 "badpallas"):
+                 "dp_2slice", "tp_flash", "fused_kernels", "badsched",
+                 "badoverlap", "badpallas"):
         assert name in proc.stdout
 
 
@@ -248,25 +281,6 @@ def test_sched_self_gate_is_clean_and_budgets_hold():
                    os.path.join("tests", "fixtures", "budgets", "sched"),
                    timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-
-
-def test_sched_badsched_reports_schedule_families():
-    """True positives through the real CLI: the seeded-bad schedule must
-    surface exposure, convoy, memory-bound and MFU-floor findings, exit
-    1, in the shared JSON shape."""
-    proc = run_cli("sched", "--target", "badsched", "--format", "json")
-    assert proc.returncode == 1
-    findings = json.loads(proc.stdout)
-    assert set(findings[0]) == {"rule", "path", "line", "message"}
-    rules = {f["rule"] for f in findings}
-    assert {"RKT501", "RKT502", "RKT503", "RKT505"} <= rules
-
-
-def test_sched_badpallas_reports_block_misfits():
-    proc = run_cli("sched", "--target", "badpallas", "--format", "json")
-    assert proc.returncode == 1
-    rules = {f["rule"] for f in json.loads(proc.stdout)}
-    assert rules == {"RKT504"}
 
 
 # -- calib form --------------------------------------------------------------
@@ -318,19 +332,6 @@ def test_serve_self_gate_is_clean_and_budgets_hold():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_serve_badserve_reports_all_five_rules():
-    """True positives through the real CLI: the seeded-bad serve config
-    (python-int in the wave signature, oversized pool, no donation,
-    unreachable ceilings) must surface every RKT60x family, exit 1, in
-    the shared JSON shape."""
-    proc = run_cli("serve", "--target", "badserve", "--format", "json")
-    assert proc.returncode == 1
-    findings = json.loads(proc.stdout)
-    assert set(findings[0]) == {"rule", "path", "line", "message"}
-    rules = {f["rule"] for f in findings}
-    assert rules == {"RKT601", "RKT602", "RKT603", "RKT604", "RKT605"}
-
-
 @pytest.mark.slow
 def test_serve_budget_regression_fails_and_rebaseline_clears(tmp_path):
     """Diff mode: shrink the committed predicted ITL by half
@@ -353,6 +354,60 @@ def test_serve_budget_regression_fails_and_rebaseline_clears(tmp_path):
     assert proc.returncode == 0
 
     proc = run_cli("serve", "--target", "tiny",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- mem form ----------------------------------------------------------------
+
+MEM_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "mem")
+
+
+def test_mem_list_targets():
+    proc = run_cli("mem", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tp_2x4", "tp_1x8", "fsdp_1x8", "tp_2x4_eval",
+                 "dp_resnet_1x8", "badmem"):
+        assert name in proc.stdout
+    assert "[demo]" in proc.stdout
+
+
+@pytest.mark.slow
+def test_mem_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: the repo's own train/eval steps
+    liveness-simulated under the committed peak-HBM budgets — zero
+    findings, exit 0. (The same gate runs as a scripts/check.sh stage;
+    slow tier here because the sweep AOT-compiles five targets.)"""
+    proc = run_cli("mem", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets", "mem"),
+                   timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_mem_budget_regression_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: shrink the committed predicted peak by half
+    (equivalently: the simulated peak grew 2x) -> RKT803, exit 1;
+    --update-budgets re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "mem"
+    budgets_dir.mkdir()
+    committed = json.load(open(os.path.join(MEM_BUDGETS, "fsdp_1x8.json")))
+    committed["predicted_peak_bytes"] = int(
+        committed["predicted_peak_bytes"] * 0.5
+    )
+    (budgets_dir / "fsdp_1x8.json").write_text(json.dumps(committed))
+
+    proc = run_cli("mem", "--target", "fsdp_1x8",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT803" in proc.stdout
+    assert "predicted_peak_bytes" in proc.stdout
+
+    proc = run_cli("mem", "--target", "fsdp_1x8",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+
+    proc = run_cli("mem", "--target", "fsdp_1x8",
                    "--budgets", str(budgets_dir))
     assert proc.returncode == 0, proc.stdout + proc.stderr
 

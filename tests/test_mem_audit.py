@@ -1,0 +1,156 @@
+"""mem_audit: the HBM liveness simulation and the RKT80x rules.
+
+The liveness model is pinned on a hand-written scheduled HLO module
+whose peak, donation aliasing and carried-across-peak set are computed
+by hand; the rule check functions are exercised as pure functions; one
+end-to-end audit AOT-compiles a tiny donated train step and must come
+back clean with a tight liveness-vs-``memory_analysis()``
+reconciliation. The five real targets' numbers are gated by the
+committed budgets (tests/test_analysis_cli.py and scripts/check.sh).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.analysis.mem_audit import (
+    MEM_TARGETS,
+    _parse_io_alias,
+    audit_memory,
+    simulate_liveness,
+)
+from rocket_tpu.analysis.rules.mem_rules import (
+    MEM_RULES,
+    check_donation_coverage,
+    check_oom_frontier,
+    check_reconciliation,
+    check_remat_effectiveness,
+)
+from rocket_tpu.analysis.sched_audit import parse_hlo_module
+
+B = 256 * 256 * 4  # one f32[256,256] buffer
+
+# Hand-scheduled module: p0 donated into output {0}; `a` is the one
+# buffer carried across the 3-buffer peak (a+b+c live during %c).
+HLO = """\
+HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias) }
+
+ENTRY %main (p0: f32[256,256], p1: f32[256,256]) -> (f32[256,256], f32[]) {
+  %p0 = f32[256,256]{1,0} parameter(0)
+  %p1 = f32[256,256]{1,0} parameter(1)
+  %a = f32[256,256]{1,0} dot(f32[256,256]{1,0} %p0, f32[256,256]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %b = f32[256,256]{1,0} exponential(f32[256,256]{1,0} %a)
+  %c = f32[256,256]{1,0} negate(f32[256,256]{1,0} %b)
+  %d = f32[256,256]{1,0} add(f32[256,256]{1,0} %a, f32[256,256]{1,0} %c)
+  %k = f32[] constant(0)
+  ROOT %t = (f32[256,256]{1,0}, f32[]) tuple(f32[256,256]{1,0} %d, f32[] %k)
+}
+"""
+
+
+def test_parse_io_alias_reads_donation_entries():
+    assert _parse_io_alias(HLO) == {0: 0}
+    assert _parse_io_alias("HloModule m, is_scheduled=true\n") == {}
+
+
+def test_simulate_liveness_peak_donation_and_carried_set():
+    entry, _ = parse_hlo_module(HLO)
+    res = simulate_liveness(entry, HLO)
+    # Arguments live the whole step; p0 is proven donated.
+    assert res.argument_bytes == 2 * B
+    assert res.donated_arg_bytes == B
+    assert res.undonated_arg_bytes == B
+    # Peak: a+b+c live while %c computes. The donated output %d writes
+    # into p0's buffer, so it adds nothing.
+    assert res.peak_temp_bytes == 3 * B
+    assert res.peak_bytes == 2 * B + 3 * B
+    # `a` (born at %a, last consumed at %d) is the only buffer carried
+    # across the peak — the saved-for-backward analogue.
+    assert res.saved_activation_bytes == B
+    bd = res.peak_breakdown
+    assert bd["state"] == B and bd["batch"] == B
+    assert bd["saved_activations"] == B and bd["temps"] == 2 * B
+    assert sum(bd.values()) == res.peak_bytes
+
+
+def test_mem_rules_catalog_ids():
+    assert [r[0] for r in MEM_RULES] == [
+        "RKT801", "RKT802", "RKT803", "RKT804", "RKT805",
+    ]
+
+
+def test_check_donation_coverage_fires_and_skips():
+    bad = check_donation_coverage(0, 1 << 20, label="t")
+    assert [f.rule for f in bad] == ["RKT801"]
+    ok = check_donation_coverage(1 << 20, 1 << 20, label="t")
+    assert ok == []
+    # Eval transforms declare expects_donation=False: never fires.
+    assert check_donation_coverage(
+        0, 1 << 20, expects_donation=False, label="t"
+    ) == []
+
+
+def test_check_remat_effectiveness_zero_ceiling_disables():
+    assert check_remat_effectiveness(1 << 30, 0, label="t") == []
+    assert [f.rule for f in check_remat_effectiveness(
+        2 << 20, 1 << 20, label="t"
+    )] == ["RKT802"]
+
+
+def test_check_oom_frontier_reports_max_batch():
+    frontier = {"TPU v5 lite": 7}
+    bad = check_oom_frontier(
+        3 << 30, 1 << 30, frontier=frontier, batch_size=32, label="t"
+    )
+    assert [f.rule for f in bad] == ["RKT804"]
+    assert "batch<=7" in bad[0].message
+    assert check_oom_frontier(1 << 20, 1 << 30, label="t") == []
+
+
+def test_check_reconciliation_floor():
+    assert [f.rule for f in check_reconciliation(
+        20 << 20, 10 << 20, floor=0.5, label="t"
+    )] == ["RKT805"]
+    assert check_reconciliation(11 << 20, 10 << 20, floor=0.5,
+                                label="t") == []
+    # No XLA reference -> nothing to reconcile against.
+    assert check_reconciliation(1 << 20, None, label="t") == []
+
+
+def test_audit_memory_clean_on_tiny_donated_step():
+    """End to end on a real AOT compile: a fully donated SGD step must
+    pass every rule and reconcile tightly with XLA's own analysis."""
+    variables = {
+        "params": {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)},
+        "state": {},
+    }
+    batch = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+
+    def step(variables, batch):
+        def loss_fn(params):
+            h = jnp.tanh(batch @ params["w"])
+            return (h * h).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+        new = jax.tree.map(lambda p, g: p - 0.1 * g,
+                           variables["params"], grads)
+        return {"params": new, "state": variables["state"]}, loss
+
+    report = audit_memory(
+        step, variables, batch, mesh_shape={"data": 1},
+        donate_argnums=(0,), label="unit",
+    )
+    assert report.clean, [f.render() for f in report.findings]
+    rec = report.record
+    assert rec["donated_bytes"] == 64 * 64 * 4
+    assert rec["predicted_peak_bytes"] > 0
+    assert rec["reconciliation_error"] is not None
+    assert rec["reconciliation_error"] < 0.25
+    assert rec["oom_frontier"]  # every known device kind gets a bound
+
+
+def test_mem_targets_cover_the_train_matrix():
+    names = set(MEM_TARGETS)
+    assert {"tp_1x8", "tp_2x4", "tp_2x4_eval", "fsdp_1x8",
+            "dp_resnet_1x8", "badmem"} <= names
+    assert MEM_TARGETS["badmem"].demo
+    assert not MEM_TARGETS["tp_2x4_eval"].expects_donation

@@ -115,6 +115,30 @@ def test_cost_model_collectives_ring_bytes_and_async_done_free():
     assert ops["all-gather-done.1"].time_s == 0.0
 
 
+def test_cost_model_prices_cross_slice_collectives_at_dcn():
+    """slice_size declares a multi-slice topology: groups confined to
+    one slice keep ICI pricing; groups (or iota groups wider than a
+    slice) spanning the boundary drop to ``spec.dcn_bw``."""
+    entry, comps = parse_hlo_module(HLO)
+    ici = {o.name: o for o in cost_ops(entry, comps, SPEC)}
+    # all-reduce groups {0,1,2,3},{4,5,6,7} stay inside 4-wide slices;
+    # the iota all-gather ([2,4]<=[8], group size 4) does too.
+    same = {o.name: o for o in cost_ops(entry, comps, SPEC, slice_size=4)}
+    assert not same["all-reduce.0"].is_dcn
+    assert not same["all-gather-start.1"].is_dcn
+    assert same["all-reduce.0"].time_s == ici["all-reduce.0"].time_s
+    # 2-wide slices split both: every group now crosses a boundary and
+    # the same bytes take dcn_bw instead of ici_bw.
+    cross = {o.name: o for o in cost_ops(entry, comps, SPEC, slice_size=2)}
+    assert cross["all-reduce.0"].is_dcn
+    assert cross["all-gather-start.1"].is_dcn
+    ar = cross["all-reduce.0"]
+    assert ar.time_s > ici["all-reduce.0"].time_s
+    assert abs(
+        ar.time_s - (ar.comm_bytes / SPEC.dcn_bw + 1e-6)
+    ) < 1e-12
+
+
 # -- the simulation ----------------------------------------------------------
 
 
