@@ -962,6 +962,51 @@ def mem_audit_summary(budgets_dir=MEM_BUDGETS_DIR):
     )
 
 
+#: Determinism-budget directory the repro auditor maintains
+#: (``python -m rocket_tpu.analysis repro --update-budgets``).
+REPRO_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "repro",
+)
+
+
+def repro_audit_summary(budgets_dir=REPRO_BUDGETS_DIR):
+    """The audited determinism record per canonical target — the
+    program fingerprint (identity-gated: CI fails on ANY
+    drift) plus the RNG-discipline counters — from the records the
+    repro self-gate verifies every CI run. Fingerprints are identities,
+    not magnitudes, so this cannot ride :func:`_budget_summary` (its
+    per-key numeric max would choke on the strings); the headline is
+    the worst random-consumer count and the fingerprinted-target tally."""
+    try:
+        from rocket_tpu.analysis import budgets as budgets_mod
+        keys = budgets_mod.REPRO_GATED_KEYS
+        names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
+            if f.endswith(".json")
+        )
+        targets = {}
+        for name in names:
+            record = budgets_mod.load_budget(budgets_dir, name)
+            if record is None:
+                continue
+            targets[name] = {key: record.get(key) for key in keys}
+        if not targets:
+            return None
+        return {
+            "targets": targets,
+            "source": "tests/fixtures/budgets/repro",
+            "random_consumers": max(
+                t.get("random_consumers") or 0 for t in targets.values()
+            ),
+            "fingerprinted_targets": sum(
+                1 for t in targets.values() if t.get("program_fingerprint")
+            ),
+        }
+    except Exception:  # noqa: BLE001 — emission must never die on this
+        return None
+
+
 SERVE_BUDGETS_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "tests", "fixtures", "budgets", "serve",
@@ -1527,6 +1572,12 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
         # train target (mem_audit budgets) — the liveness simulation's
         # numbers the memory self-gate verifies every CI run.
         detail["mem"] = mem
+    repro = repro_audit_summary(REPRO_BUDGETS_DIR)
+    if repro is not None:
+        # The determinism audit's committed identities (program
+        # fingerprints, exact-equality gated in CI) + RNG-discipline
+        # counters — the reproducibility claim the bench numbers rest on.
+        detail["repro"] = repro
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

@@ -1,5 +1,6 @@
 """CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` |
-``prec`` | ``sched`` | ``serve`` | ``calib`` | ``mem``.
+``prec`` | ``sched`` | ``serve`` | ``calib`` | ``mem`` | ``repro`` |
+``all``.
 
 Several entry forms, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
@@ -36,7 +37,16 @@ Several entry forms, one process contract (exit 0 = clean, 1 = findings,
   optimizer state / saved-for-backward activations / collective
   buffers / temps, donation-coverage proof, remat effectiveness, the
   OOM frontier per device kind, a reconciliation cross-check against
-  ``compiled.memory_analysis()``, and the memory budgets.
+  ``compiled.memory_analysis()``, and the memory budgets;
+* ``repro`` audits the *determinism story*
+  (:mod:`rocket_tpu.analysis.repro_audit`): PRNG-key provenance through
+  the traced program (key reuse, unfolded loop keys), nondeterministic
+  compiled ops, the checkpoint resume-identity and serve wave-replay
+  fingerprint proofs, the executed bitwise-replay sentinel, and the
+  fingerprint budgets;
+* ``all`` runs rocketlint plus every family above in one process with
+  one merged findings list — the single invocation check.sh/ci.yml
+  gate on.
 
 The audit subcommands are one registry (:data:`AUDIT_SUBCOMMANDS`)
 sharing a single flag set and budget write/diff loop, so ``--format``
@@ -53,8 +63,10 @@ example inputs, so they run from code/tests via
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
-from dataclasses import dataclass
+from dataclasses import asdict, dataclass
 from typing import Callable
 
 from rocket_tpu.analysis.backend import provision_cpu_backend
@@ -127,6 +139,15 @@ def _load_mem():
     from rocket_tpu.analysis.mem_audit import MEM_TARGETS, run_mem_target
 
     return MEM_TARGETS, run_mem_target
+
+
+def _load_repro():
+    from rocket_tpu.analysis.repro_audit import (
+        REPRO_TARGETS,
+        run_repro_target,
+    )
+
+    return REPRO_TARGETS, run_repro_target
 
 
 def _mesh_line(target) -> str:
@@ -225,8 +246,70 @@ AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
                 + ("" if t.expects_donation else "  [eval]")
             ),
         ),
+        AuditCLI(
+            name="repro",
+            description="static determinism / RNG-discipline audit: "
+                        "PRNG-key provenance (reuse, unfolded loop "
+                        "keys), nondeterministic compiled ops, "
+                        "checkpoint resume-identity and wave-replay "
+                        "fingerprint proofs, executed replay sentinel",
+            load=_load_repro,
+            budgets_dir_attr="REPRO_DIR",
+            gated_keys_attr="REPRO_GATED_KEYS",
+            budget_rule="RKT906",
+            family="repro",
+            list_line=lambda t: (
+                f"kind={t.kind}"
+                + (f" {_mesh_line(t)}" if t.mesh_shape else "")
+            ),
+        ),
     )
 }
+
+
+def _sweep_targets(cli: AuditCLI, *, names=None, budgets_dir=None,
+                   update_budgets=False, tolerance=None) -> list:
+    """The one per-target audit sweep both ``_audit_main`` and the
+    ``all`` umbrella run: demo targets are skipped unless named, and
+    each non-demo record is written (``--update-budgets``) or diffed
+    against the committed budget."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    targets, run_target = cli.load()
+    budget_keys = getattr(budgets_mod, cli.gated_keys_attr)
+    if tolerance is None:
+        tolerance = budgets_mod.TOLERANCE
+    if names is None:
+        names = [
+            name for name, target in targets.items() if not target.demo
+        ]
+    findings = []
+    for name in names:
+        target = targets[name]
+        report = run_target(target)
+        findings.extend(report.findings)
+        if target.demo or not budgets_dir or not report.record:
+            continue
+        if update_budgets:
+            budgets_mod.write_budget(budgets_dir, name, report.record)
+        else:
+            findings.extend(budgets_mod.diff_budget(
+                name, budgets_mod.load_budget(budgets_dir, name),
+                report.record, tolerance=tolerance,
+                keys=budget_keys, rule=cli.budget_rule, family=cli.family,
+            ))
+    return findings
+
+
+def _write_json_report(path: str, findings) -> None:
+    """Machine-readable copy of the findings (the ``--format json``
+    shape), written unconditionally so CI can upload it on failure."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump([asdict(f) for f in findings], fh, indent=2)
+        fh.write("\n")
 
 
 def _audit_main(cli: AuditCLI, argv) -> int:
@@ -236,9 +319,8 @@ def _audit_main(cli: AuditCLI, argv) -> int:
     provision_cpu_backend(force_cpu_default=not cli.measures)
     from rocket_tpu.analysis import budgets as budgets_mod
 
-    targets, run_target = cli.load()
+    targets, _run_target = cli.load()
     default_dir = getattr(budgets_mod, cli.budgets_dir_attr)
-    budget_keys = getattr(budgets_mod, cli.gated_keys_attr)
 
     parser = argparse.ArgumentParser(
         prog=f"python -m rocket_tpu.analysis {cli.name}",
@@ -267,6 +349,11 @@ def _audit_main(cli: AuditCLI, argv) -> int:
     )
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument(
+        "--json-report", default=None, metavar="PATH",
+        help="also write the findings as JSON to PATH (the --format "
+        "json shape), regardless of --format — the artifact CI uploads",
+    )
     args = parser.parse_args(argv)
 
     if args.list_targets:
@@ -277,25 +364,81 @@ def _audit_main(cli: AuditCLI, argv) -> int:
     if args.update_budgets and not args.budgets:
         parser.error("--update-budgets requires --budgets DIR")
 
-    names = args.target or [
-        name for name, target in targets.items() if not target.demo
-    ]
-    findings = []
-    for name in names:
-        target = targets[name]
-        report = run_target(target)
-        findings.extend(report.findings)
-        if target.demo or not args.budgets or not report.record:
-            continue
-        if args.update_budgets:
-            budgets_mod.write_budget(args.budgets, name, report.record)
-        else:
-            findings.extend(budgets_mod.diff_budget(
-                name, budgets_mod.load_budget(args.budgets, name),
-                report.record, tolerance=args.tolerance,
-                keys=budget_keys, rule=cli.budget_rule, family=cli.family,
-            ))
+    findings = _sweep_targets(
+        cli, names=args.target, budgets_dir=args.budgets,
+        update_budgets=args.update_budgets, tolerance=args.tolerance,
+    )
 
+    if args.json_report:
+        _write_json_report(args.json_report, findings)
+    emit_findings(findings, fmt=args.format)
+    return 1 if findings else 0
+
+
+def _all_main(argv) -> int:
+    """``python -m rocket_tpu.analysis all``: rocketlint over the given
+    paths plus every registered audit family, one merged findings list,
+    the shared exit-0/1/2 contract — so check.sh/ci.yml run one
+    invocation instead of seven."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.analysis all",
+        description="run rocketlint plus every registered audit family "
+                    "(" + ", ".join(AUDIT_SUBCOMMANDS) + ") in one "
+                    "process with one merged findings list",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: rocket_tpu)",
+    )
+    parser.add_argument(
+        "--budgets", default=None, metavar="ROOT",
+        help="budgets ROOT (canonical: tests/fixtures/budgets): each "
+        "family diffs against its canonical subdirectory under ROOT",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=budgets_mod.TOLERANCE,
+        help="allowed relative growth before a budget diff fails",
+    )
+    parser.add_argument(
+        "--calib-tolerance", type=float, default=0.5,
+        help="separate tolerance for the calib family (measured timings "
+        "on shared CI hosts are noisy; default 0.5)",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument(
+        "--json-report", default=None, metavar="PATH",
+        help="also write the merged findings as JSON to PATH",
+    )
+    args = parser.parse_args(argv)
+
+    # One backend provisioning for every family: the static audits need
+    # the fake 8-device CPU mesh; calib then measures on the same CPU
+    # backend (exactly what check.sh/ci.yml pin anyway).
+    provision_cpu_backend(force_cpu_default=True)
+
+    findings = list(lint_paths(args.paths or ["rocket_tpu"]))
+    for cli in AUDIT_SUBCOMMANDS.values():
+        family_dir = None
+        if args.budgets:
+            canonical = getattr(budgets_mod, cli.budgets_dir_attr)
+            rel = os.path.relpath(canonical, budgets_mod.DEFAULT_DIR)
+            family_dir = (
+                args.budgets if rel == os.curdir
+                else os.path.join(args.budgets, rel)
+            )
+        tolerance = (
+            args.calib_tolerance if cli.name == "calib"
+            else args.tolerance
+        )
+        findings.extend(_sweep_targets(
+            cli, budgets_dir=family_dir, tolerance=tolerance,
+        ))
+
+    if args.json_report:
+        _write_json_report(args.json_report, findings)
     emit_findings(findings, fmt=args.format)
     return 1 if findings else 0
 
@@ -304,6 +447,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in AUDIT_SUBCOMMANDS:
         return _audit_main(AUDIT_SUBCOMMANDS[argv[0]], argv[1:])
+    if argv and argv[0] == "all":
+        return _all_main(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="python -m rocket_tpu.analysis",
@@ -328,7 +473,8 @@ def main(argv=None) -> int:
         return 0
     if not args.paths:
         parser.error("no paths given (or use --list-rules, or a "
-                     "subcommand: " + ", ".join(AUDIT_SUBCOMMANDS) + ")")
+                     "subcommand: all, "
+                     + ", ".join(AUDIT_SUBCOMMANDS) + ")")
 
     select = (
         [r.strip() for r in args.select.split(",") if r.strip()]

@@ -46,6 +46,7 @@ __all__ = [
     "SERVE_GATED_KEYS",
     "CALIB_GATED_KEYS",
     "MEM_GATED_KEYS",
+    "REPRO_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -95,6 +96,14 @@ SERVE_GATED_KEYS = ("predicted_itl_us", "predicted_ttft_us",
 #: hardware.
 MEM_GATED_KEYS = ("predicted_peak_bytes", "saved_activation_bytes")
 
+#: Record keys the determinism gate compares — RKT906. The program
+#: fingerprint is a string identity, not a monotone cost: ANY drift vs
+#: the committed value fails (the canonicalized traced program changed,
+#: so bitwise resume/replay claims need re-certifying). The RNG-consumer
+#: count gates the step's randomness surface — a new unreviewed random
+#: draw shows up as growth.
+REPRO_GATED_KEYS = ("program_fingerprint", "random_consumers")
+
 #: Default budgets directory, resolved relative to the repo checkout.
 #: The precision/schedule/serving budgets live in ``prec/`` / ``sched/``
 #: / ``serve/`` subdirectories so BENCH's per-target sweep over
@@ -105,6 +114,7 @@ SCHED_DIR = os.path.join(DEFAULT_DIR, "sched")
 SERVE_DIR = os.path.join(DEFAULT_DIR, "serve")
 CALIB_DIR = os.path.join(DEFAULT_DIR, "calib")
 MEM_DIR = os.path.join(DEFAULT_DIR, "mem")
+REPRO_DIR = os.path.join(DEFAULT_DIR, "repro")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -153,7 +163,7 @@ def diff_budget(
     path = f"<{family}:{target}>"
     subcommand = {
         "spmd": "shard", "sched": "sched", "serve": "serve",
-        "calib": "calib", "mem": "mem",
+        "calib": "calib", "mem": "mem", "repro": "repro",
     }.get(family, "prec")
     if committed is None:
         return [Finding(
@@ -174,6 +184,18 @@ def diff_budget(
     for key in keys:
         old = committed.get(key)
         new = measured.get(key)
+        if isinstance(old, str) or isinstance(new, str):
+            # Identity keys (program fingerprints): equality, not growth
+            # — any drift means the compiled/traced program changed.
+            if old != new:
+                findings.append(Finding(
+                    rule, path, 0,
+                    f"budget-regression: {key} changed ({old!r} -> "
+                    f"{new!r}) — the committed fingerprint no longer "
+                    "matches this program; if the change is intended, "
+                    "re-baseline with --update-budgets",
+                ))
+            continue
         if not isinstance(old, (int, float)) or not isinstance(new, (int, float)):
             continue
         if old <= 0:

@@ -152,7 +152,7 @@ def simulate_liveness(
     is_arg: dict[str, bool] = {}
 
     for idx, instr in enumerate(entry):
-        for operand in set(instr.operands):
+        for operand in sorted(set(instr.operands)):
             for buf in alias_sets.get(operand, ()):
                 last_use[buf] = idx
         if instr.opcode == "parameter":

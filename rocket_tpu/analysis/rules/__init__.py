@@ -20,6 +20,10 @@ from rocket_tpu.analysis.rules.capsule_rules import (
     LaunchHostSyncRule,
 )
 from rocket_tpu.analysis.rules.dtype_rules import StringDtypeRule
+from rocket_tpu.analysis.rules.entropy_rules import (
+    AmbientEntropyRule,
+    UnorderedIterationRule,
+)
 from rocket_tpu.analysis.rules.host_rules import (
     ForkStartMethodRule,
     SyncInLoopRule,
@@ -33,6 +37,7 @@ from rocket_tpu.analysis.rules.calib_rules import CALIB_RULES
 from rocket_tpu.analysis.rules.mem_rules import MEM_RULES
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
 from rocket_tpu.analysis.rules.race_rules import UnlockedMutationRule
+from rocket_tpu.analysis.rules.repro_rules import REPRO_RULES
 from rocket_tpu.analysis.rules.retry_rules import SwallowedInterruptRule
 from rocket_tpu.analysis.rules.sched_rules import SCHED_RULES
 from rocket_tpu.analysis.rules.serve_rules import SERVE_RULES
@@ -40,7 +45,7 @@ from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
 __all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
            "SCHED_RULES", "SERVE_RULES", "CALIB_RULES", "MEM_RULES",
-           "all_rules"]
+           "REPRO_RULES", "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -52,6 +57,8 @@ AST_RULES = (
     LaunchHostSyncRule(),
     ForkStartMethodRule(),
     StringDtypeRule(),
+    UnorderedIterationRule(),
+    AmbientEntropyRule(),
     UnlockedMutationRule(),
     SwallowedInterruptRule(),
     UndonatedJitStateRule(),
@@ -89,5 +96,5 @@ def all_rules():
     return tuple(sorted(
         ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
         + list(SCHED_RULES) + list(SERVE_RULES) + list(CALIB_RULES)
-        + list(MEM_RULES)
+        + list(MEM_RULES) + list(REPRO_RULES)
     ))

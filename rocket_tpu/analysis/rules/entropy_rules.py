@@ -1,0 +1,188 @@
+"""Determinism-hygiene AST rules: iteration order and ambient entropy.
+
+Bitwise reproducibility dies at trace *construction* as easily as at run
+time: iterating a ``set`` while assembling a param tree or applying rule
+globs bakes a hash-seed-dependent order into the traced program
+(``PYTHONHASHSEED`` randomizes ``str``/``bytes`` hashing per process),
+and ``time.time()`` / ``os.urandom()`` / unseeded ``random.*`` reached
+from step-construction code bakes a different constant into every
+build. Both break the repro_audit fingerprint proofs (RKT903/RKT904)
+without any random *primitive* appearing in the program — which is why
+they get AST rules, not jaxpr rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["UnorderedIterationRule", "AmbientEntropyRule"]
+
+
+def _call_name(node: ast.AST):
+    from rocket_tpu.analysis.rocketlint import _call_name as impl
+
+    return impl(node)
+
+
+_SET_CALLS = frozenset({"set", "frozenset"})
+#: set methods returning a new set — iterating the result is just as
+#: order-unstable as iterating a set display.
+_SET_METHODS = frozenset({
+    "intersection", "union", "difference", "symmetric_difference",
+})
+#: Wrappers that FREEZE the iteration order into a sequence — the
+#: classic ``list(set(xs))`` dedup keeps the unstable order; only
+#: ``sorted(...)`` launders it.
+_ORDER_FREEZERS = frozenset({"list", "tuple"})
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        if _call_name(node.func) in _SET_CALLS:
+            return True
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SET_METHODS):
+            return True
+    return False
+
+
+class UnorderedIterationRule:
+    rule_id = "RKT112"
+    slug = "unordered-iteration-in-trace-path"
+    contract = (
+        "iterating a set (or list(set(...)) dedup) without sorted(): "
+        "str/bytes hashing is randomized per process, so the order — "
+        "and any param tree, rule application or float accumulation "
+        "built from it — differs between otherwise identical runs"
+    )
+
+    def _sites(self, ctx) -> Iterable[tuple]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                yield node.iter, node, "for-loop"
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    yield gen.iter, node, "comprehension"
+            elif (isinstance(node, ast.Call)
+                  and _call_name(node.func) in _ORDER_FREEZERS
+                  and len(node.args) == 1):
+                yield node.args[0], node, f"{_call_name(node.func)}()"
+
+    def check(self, ctx) -> Iterable[Finding]:
+        # Local names bound (exactly once) to a set expression: catch
+        # `keys = set(...); for k in keys:` — but only inside jit
+        # regions, where the unstable order provably reaches the trace.
+        set_names: dict[str, int] = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                name = node.targets[0].id
+                if _is_set_expr(node.value):
+                    set_names[name] = set_names.get(name, 0) + 1
+                else:
+                    set_names[name] = 99  # rebound: unknowable
+        single_set_names = {n for n, c in set_names.items() if c == 1}
+
+        for iter_expr, site, where in self._sites(ctx):
+            direct = _is_set_expr(iter_expr)
+            inferred = (
+                isinstance(iter_expr, ast.Name)
+                and iter_expr.id in single_set_names
+                and ctx.in_jit_region(site)
+            )
+            if not direct and not inferred:
+                continue
+            yield Finding(
+                self.rule_id, ctx.path, site.lineno,
+                f"set iterated in a {where} without sorted(): the order "
+                "is hash-seed-dependent and differs between runs — wrap "
+                "in sorted() (or sorted(..., key=...)) before the order "
+                "can reach a trace, a param tree or an accumulation",
+            )
+
+
+#: Entropy calls that are a bug ANYWHERE inside a jit region (the value
+#: is sampled once at trace time and baked in as a constant) and in
+#: step-construction modules (the built program differs per process).
+_ENTROPY_CALLS = frozenset({
+    "os.urandom", "uuid.uuid4", "uuid.uuid1", "secrets.token_bytes",
+    "secrets.token_hex", "secrets.randbits",
+})
+#: time is fine in host telemetry; inside a jit region it is always a
+#: trace-time constant bug.
+_TIME_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+})
+#: Unseeded stdlib/numpy global-state RNG entry points. The seeded /
+#: object forms (random.Random(seed), np.random.RandomState(seed),
+#: np.random.default_rng(seed)) are fine and excluded.
+_GLOBAL_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_GLOBAL_RNG_SEEDED = frozenset({
+    "random.Random", "np.random.RandomState", "numpy.random.RandomState",
+    "np.random.default_rng", "numpy.random.default_rng",
+    "np.random.Generator", "numpy.random.Generator",
+    "np.random.PCG64", "numpy.random.PCG64",
+})
+
+#: Path fragments naming the step-construction modules: code here builds
+#: what gets traced/compiled, so ambient entropy anywhere in the file is
+#: in scope (not just inside explicit jit regions).
+_STEP_PATH_FRAGMENTS = (
+    "rocket_tpu/core/", "rocket_tpu/nn/", "rocket_tpu/models/",
+    "rocket_tpu/ops/",
+)
+
+
+class AmbientEntropyRule:
+    rule_id = "RKT113"
+    slug = "ambient-entropy-in-step"
+    contract = (
+        "time.time()/os.urandom()/uuid4()/unseeded random.*/builtin "
+        "hash() inside a jit region or in step-construction code "
+        "(rocket_tpu/{core,nn,models,ops}): the value differs per "
+        "process (PYTHONHASHSEED randomizes hash()), so the built "
+        "program is not reproducible — thread a seed or a jax.random "
+        "key instead"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        norm = ctx.path.replace("\\", "/")
+        step_scope = any(f in norm for f in _STEP_PATH_FRAGMENTS)
+        for call in ctx.walk_calls():
+            in_jit = ctx.in_jit_region(call)
+            if not in_jit and not step_scope:
+                continue
+            name = _call_name(call.func)
+            hit = None
+            if name in _ENTROPY_CALLS:
+                hit = f"{name}()"
+            elif name in _TIME_CALLS:
+                # Host-side telemetry timestamps are legitimate; only a
+                # traced region bakes the clock into the program.
+                if in_jit:
+                    hit = f"{name}() (a trace-time constant here)"
+            elif name == "hash" and len(call.args) == 1:
+                hit = "builtin hash() (randomized by PYTHONHASHSEED)"
+            elif (name and name.startswith(_GLOBAL_RNG_PREFIXES)
+                  and name not in _GLOBAL_RNG_SEEDED):
+                # Inside jit regions RKT102 already owns host-RNG calls;
+                # re-reporting the same line would double-count.
+                if not in_jit:
+                    hit = f"{name}() (unseeded global-state RNG)"
+            if hit:
+                yield Finding(
+                    self.rule_id, ctx.path, call.lineno,
+                    f"{hit} reaches step construction: the value differs "
+                    "per process, so two builds of the same step are not "
+                    "bitwise-identical — thread an explicit seed / "
+                    "jax.random key (or hoist the call out of the step "
+                    "path)",
+                )

@@ -1,0 +1,229 @@
+"""Determinism / reproducibility rules (RKT901-906) — check functions.
+
+The repo's headline contracts are all *bitwise*: eviction/resume in
+serve replays identically, resilience resumes-not-restarts, the overlap
+off-switch compiles the identical program. Two things silently break
+every one of them: PRNG-key misuse (a key consumed twice samples
+correlated noise; a loop body consuming an unfolded key repeats the
+same "random" draw every iteration) and nondeterministic compiled ops
+(float scatter-add over duplicate indices, backend-default RNG
+algorithms). :mod:`rocket_tpu.analysis.repro_audit` extracts the facts
+— key-provenance consumption sites from the traced jaxpr, nondet ops
+from the optimized HLO, program fingerprints from the canonicalized
+compile — and the pure check functions here turn them into findings,
+so the rules are unit-testable without a trace or a compile.
+
+RKT906 is the budget/fingerprint gate
+(:func:`rocket_tpu.analysis.budgets.diff_budget` with
+``REPRO_GATED_KEYS``): a committed program fingerprint that no longer
+matches means the step's compiled identity changed — re-baseline
+deliberately or treat it as the regression it usually is.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Optional, Sequence
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "REPRO_RULES",
+    "check_key_reuse",
+    "check_nondet_hlo",
+    "check_resume_identity",
+    "check_wave_invariance",
+    "check_replay_sentinel",
+]
+
+#: (id, slug, contract) for --list-rules and docs/analysis.md.
+REPRO_RULES = (
+    ("RKT901", "prng-key-reuse",
+     "a PRNG key value is consumed by two random primitives, or a loop "
+     "body consumes a key not folded with the loop carry/counter: "
+     "correlated samples / the same draw every iteration"),
+    ("RKT902", "nondeterministic-hlo",
+     "the optimized HLO contains a nondeterministic op: float "
+     "scatter-add without unique_indices, a backend-default "
+     "rng-bit-generator algorithm, or a known-nondeterministic "
+     "custom-call target"),
+    ("RKT903", "resume-identity",
+     "the train step compiled through the checkpoint save/restore path "
+     "must fingerprint-match the fresh build: resume is bit-identical "
+     "only if restore reproduces the exact compiled program"),
+    ("RKT904", "wave-replay-identity",
+     "the k-wave decode scan body must fingerprint-match across "
+     "waves_per_dispatch values: re-dispatch boundaries (eviction/"
+     "resume, drain) must not change the per-wave program"),
+    ("RKT905", "replay-divergence",
+     "the sentinel train step executed twice from identical donated "
+     "state must produce bitwise-equal params and health word"),
+    ("RKT906", "repro-budget-regression",
+     "a gated determinism metric regressed (or a committed program "
+     "fingerprint drifted) vs tests/fixtures/budgets/repro/"),
+)
+
+
+def _repro_path(label: str) -> str:
+    return f"<repro:{label}>"
+
+
+def check_key_reuse(
+    consumptions: Mapping[object, Sequence[str]],
+    unfolded: Iterable[tuple],
+    *,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT901 over the key-provenance walk's facts.
+
+    ``consumptions`` maps each key identity to the list of sites that
+    consumed it (two or more sites = the same key value fed two random
+    primitives). ``unfolded`` lists ``(site, origin)`` pairs for
+    loop-body consumptions of a key whose value is provably identical on
+    every iteration (entered the loop from outside and was never folded
+    with anything loop-varying).
+    """
+    findings = []
+    for kid in sorted(consumptions, key=str):
+        sites = consumptions[kid]
+        if len(sites) < 2:
+            continue
+        findings.append(Finding(
+            "RKT901", _repro_path(label), 0,
+            f"prng-key-reuse: the same key value is consumed by "
+            f"{len(sites)} random primitives ({', '.join(sites[:4])}"
+            f"{', ...' if len(sites) > 4 else ''}) — split or fold_in "
+            "before each use; reused keys sample correlated noise",
+        ))
+    for site, origin in sorted(set(unfolded)):
+        findings.append(Finding(
+            "RKT901", _repro_path(label), 0,
+            f"prng-key-reuse: loop body consumes a loop-invariant key "
+            f"({site}, key from {origin}) without folding in the loop "
+            "carry/counter — every iteration repeats the same draw; "
+            "fold_in(key, step) (or scan per-iteration keys) first",
+        ))
+    return findings
+
+
+def check_nondet_hlo(
+    nondet_ops: Sequence[tuple],
+    *,
+    scatter_allow: Sequence[str] = (),
+    label: str = "step",
+) -> list[Finding]:
+    """RKT902 over the optimized-HLO scan's facts.
+
+    ``nondet_ops`` holds ``(kind, name, detail)`` triples extracted by
+    :func:`rocket_tpu.analysis.repro_audit.scan_nondeterministic_hlo`
+    (kind in {"scatter", "rng", "custom-call"}). ``scatter_allow``
+    lists reviewed substrings (matched against the instruction's
+    op_name/ name) for float scatter-adds that are accepted, e.g. the
+    embedding-table gradient — XLA expands those with a fixed
+    combine order on TPU/CPU (deterministic run-to-run on one binary)
+    but GPU backends may parallelize the combine, so each allowed site
+    is an explicit, reviewable exception like a certified collective.
+    """
+    findings = []
+    allow = tuple(scatter_allow)
+    for kind, name, detail in nondet_ops:
+        if kind == "scatter" and any(pat in name or pat in detail
+                                     for pat in allow):
+            continue
+        if kind == "scatter":
+            msg = (
+                f"nondeterministic-hlo: float scatter-add without "
+                f"unique_indices at {name} ({detail}) — duplicate "
+                "indices combine in implementation-defined order; pass "
+                "unique_indices=True when indices are unique, or "
+                "allow-list the reviewed site on the audit target"
+            )
+        elif kind == "rng":
+            msg = (
+                f"nondeterministic-hlo: {name} uses a backend-default "
+                f"RNG algorithm ({detail}) — pin threefry/philox "
+                "(jax_default_prng_impl) for cross-backend replay"
+            )
+        else:
+            msg = (
+                f"nondeterministic-hlo: custom-call {name} targets "
+                f"{detail}, a known-nondeterministic kernel"
+            )
+        findings.append(Finding("RKT902", _repro_path(label), 0, msg))
+    return findings
+
+
+def check_resume_identity(
+    fresh_fingerprint: Optional[str],
+    restored_fingerprint: Optional[str],
+    *,
+    label: str = "step",
+) -> list[Finding]:
+    """RKT903: the canonicalized compiled-HLO fingerprint of the step
+    built fresh vs built from state round-tripped through
+    ``checkpoint_io.save_pytree``/``load_pytree`` must match."""
+    if fresh_fingerprint is None or restored_fingerprint is None:
+        return []
+    if fresh_fingerprint == restored_fingerprint:
+        return []
+    return [Finding(
+        "RKT903", _repro_path(label), 0,
+        f"resume-identity: the train step compiled through the "
+        f"checkpoint restore path fingerprints {restored_fingerprint} "
+        f"vs {fresh_fingerprint} fresh — restore changed the compiled "
+        "program (dtype/layout/sharding drift in load_pytree), so "
+        "resume is NOT bit-identical",
+    )]
+
+
+def check_wave_invariance(
+    fingerprints: Mapping[int, str],
+    *,
+    label: str = "serve",
+) -> list[Finding]:
+    """RKT904: the decode scan's per-wave body program must fingerprint
+    identically for every ``waves_per_dispatch`` — the engine's
+    eviction-resume contract (greedy outputs bit-identical across
+    re-dispatch boundaries) holds only if the per-wave math never reads
+    k."""
+    if len(fingerprints) < 2:
+        return []
+    by_fp: dict[str, list[int]] = {}
+    for k in sorted(fingerprints):
+        by_fp.setdefault(fingerprints[k], []).append(k)
+    if len(by_fp) == 1:
+        return []
+    groups = "; ".join(
+        f"waves={ks} -> {fp}" for fp, ks in sorted(by_fp.items())
+    )
+    return [Finding(
+        "RKT904", _repro_path(label), 0,
+        f"wave-replay-identity: the per-wave decode body differs "
+        f"across waves_per_dispatch ({groups}) — k leaked into the "
+        "per-wave math, so an eviction/resume that re-dispatches at a "
+        "different wave boundary replays different tokens",
+    )]
+
+
+def check_replay_sentinel(
+    mismatches: Sequence[str],
+    *,
+    executed: bool = True,
+    label: str = "sentinel",
+) -> list[Finding]:
+    """RKT905: the sentinel step run twice from identical donated state
+    must produce bitwise-equal outputs; ``mismatches`` names the output
+    leaves whose bytes differed."""
+    if not executed:
+        return [Finding(
+            "RKT905", _repro_path(label), 0,
+            "replay-divergence: the sentinel step could not execute — "
+            "the bitwise-replay proof did not run",
+        )]
+    if not mismatches:
+        return []
+    return [Finding(
+        "RKT905", _repro_path(label), 0,
+        f"replay-divergence: two executions from identical donated "
+        f"state produced different bytes at {sorted(mismatches)[:6]} — "
+        "the compiled step is not replay-deterministic on this backend",
+    )]

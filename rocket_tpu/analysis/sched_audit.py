@@ -445,7 +445,7 @@ def cost_ops(
     ops: list[OpCost] = []
     for instr in entry:
         operand_bytes = sum(
-            by_name[o].result_bytes for o in set(instr.operands)
+            by_name[o].result_bytes for o in sorted(set(instr.operands))
             if o in by_name
         )
         hbm_bytes = operand_bytes + instr.result_bytes

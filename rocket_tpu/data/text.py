@@ -188,7 +188,7 @@ class BPETokenizer:
             # canonical BPE application order.
             ranked = [
                 (self._ranks[p], p)
-                for p in set(zip(seq, seq[1:]))
+                for p in sorted(set(zip(seq, seq[1:])))
                 if p in self._ranks
             ]
             if not ranked:

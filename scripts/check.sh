@@ -1,15 +1,13 @@
 #!/usr/bin/env bash
-# One-shot CI gate: style lint (ruff) + framework lint (rocketlint) +
-# tune table gate (checked-in kernel-config legality + stale structural
-# winners) + structural kernel-search smoke + SPMD shard
-# audit (self-gate + budget diff) + precision audit (dtype-flow
-# self-gate + numerics budgets) + schedule audit + calibration audit
-# (live device-trace capture reconciled against the priced HLO DAG +
-# drift budgets) + serving audit (retrace-surface/latency/HBM
-# self-gate + serving budgets) + memory audit (HBM liveness self-gate
-# + peak budgets) + obs telemetry smoke + resilience
-# smoke (supervised restart / drain) + the tier-1 test suite (command
-# from ROADMAP.md). Exits non-zero on the first failing stage.
+# One-shot CI gate: style lint (ruff) + tune table gate (checked-in
+# kernel-config legality + stale structural winners) + structural
+# kernel-search smoke + the `analysis all` umbrella (rocketlint +
+# every audit family — shard/prec/sched/serve/calib/mem/repro — one
+# process, one merged findings list, budgets diffed per family) +
+# seeded-bad true-positive legs (badoverlap, drifted calib, badmem,
+# badrepro) + obs telemetry smoke + resilience smoke (supervised
+# restart / drain) + the tier-1 test suite (command from ROADMAP.md).
+# Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,9 +17,6 @@ if command -v ruff >/dev/null 2>&1; then
 else
     echo "ruff not installed - skipping style lint (config in pyproject.toml)"
 fi
-
-echo "== rocketlint (python -m rocket_tpu.analysis) =="
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis rocket_tpu/
 
 echo "== tune table gate (schema + legality of checked-in kernel configs) =="
 # Validates every entry in rocket_tpu/tune/configs/*.json: schema
@@ -41,27 +36,18 @@ echo "== structural kernel search smoke (enumerate -> verify -> table round-trip
 # variant must fail the table gate loudly.
 JAX_PLATFORMS=cpu python scripts/tune_structural_smoke.py
 
-echo "== shard audit (SPMD self-gate + budgets) =="
-# Fake 1x8 / 2x4 CPU meshes; fails on sharding-rule findings or a >10%
-# collective-bytes / HBM regression over tests/fixtures/budgets/.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis shard \
-    --budgets tests/fixtures/budgets
-
-echo "== precision audit (dtype-flow self-gate + numerics budgets) =="
-# Walks the traced train/eval steps; fails on mixed-precision findings
-# (RKT401-405) or a >10% fp32-bytes-fraction / cast-count regression
-# over tests/fixtures/budgets/prec/.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis prec \
-    --budgets tests/fixtures/budgets/prec
-
-echo "== schedule audit (roofline self-gate + schedule budgets) =="
-# Roofline + two-stream simulation over the AOT-compiled steps; fails on
-# schedule findings (RKT501-505: exposed/convoyed collectives,
-# memory-bound critical paths, pallas block misfits, predicted-MFU
-# floors) or a >10% predicted-step-time / exposed-comm regression over
-# tests/fixtures/budgets/sched/.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis sched \
-    --budgets tests/fixtures/budgets/sched
+echo "== analysis all (rocketlint + every audit family, one invocation) =="
+# Replaces the seven per-family invocations: rocketlint over
+# rocket_tpu/ plus shard/prec/sched/serve/calib/mem/repro, each family
+# diffed against its canonical subdirectory of tests/fixtures/budgets/
+# (>10% growth fails; calib uses tolerance 0.5 because its measured
+# side is a live timing on a CPU container; repro fingerprints gate on
+# exact equality). The merged findings land in
+# runs/audit_reports/check.json — the artifact CI uploads on failure.
+mkdir -p runs/audit_reports
+JAX_PLATFORMS=cpu python -m rocket_tpu.analysis all rocket_tpu/ \
+    --budgets tests/fixtures/budgets --calib-tolerance 0.5 \
+    --json-report runs/audit_reports/check.json
 
 echo "== overlap true-positive (seeded-bad badoverlap demo) =="
 # The overlapped-collective rules must still FIND the unoverlapped
@@ -75,18 +61,6 @@ fi
 grep -q "RKT501" /tmp/_badoverlap.txt && grep -q "RKT502" /tmp/_badoverlap.txt || {
     echo "badoverlap demo missing RKT501/RKT502:"; cat /tmp/_badoverlap.txt; exit 1;
 }
-
-echo "== calibration audit (measured-vs-predicted reconcile + drift budgets) =="
-# Captures a live device trace of the canonical steps (gpt2 sentinel,
-# fsdp_1x8, the tiny serve engine's decode), buckets it per HLO op
-# (obs.prof), reconciles against the priced optimized-HLO DAG and fails
-# on RKT70x findings or calibration-error / unjoined-fraction drift
-# over tests/fixtures/budgets/calib/. Tolerance 0.5: the measured side
-# is a live timing, and on this CPU container the error is pinned near
-# 1.0 by the device mismatch — a model or join regression still blows
-# through, run-to-run noise does not.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis calib \
-    --budgets tests/fixtures/budgets/calib --tolerance 0.5
 
 echo "== calibration drift true-positive (seeded-bad drifted budget) =="
 # The drift gate must still FIND things: a committed budget claiming
@@ -103,25 +77,6 @@ grep -q "RKT701" /tmp/_calib_drift.txt || {
     echo "drifted-budget leg missing RKT701:"; cat /tmp/_calib_drift.txt; exit 1;
 }
 
-echo "== serving audit (retrace-surface / latency-roofline / HBM-fit self-gate + serving budgets) =="
-# AOT-compiles the real decode-wave/prefill programs and drives the real
-# scheduler through the admission lattice; fails on serving findings
-# (RKT601-605: retrace surface, decode overfetch, pool HBM overflow,
-# donation/host-transfer, latency ceilings) or a >10% predicted-ITL/
-# TTFT/HBM regression over tests/fixtures/budgets/serve/.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis serve \
-    --budgets tests/fixtures/budgets/serve
-
-echo "== memory audit (HBM liveness self-gate + peak budgets) =="
-# Replays each AOT-compiled train/eval step's scheduled HLO as a buffer
-# liveness simulation (donation-aware); fails on memory findings
-# (RKT801/802/804/805: undonated state, ineffective remat, OOM
-# frontier, liveness-vs-memory_analysis divergence) or a >10%
-# predicted-peak / saved-activation regression over
-# tests/fixtures/budgets/mem/.
-JAX_PLATFORMS=cpu python -m rocket_tpu.analysis mem \
-    --budgets tests/fixtures/budgets/mem
-
 echo "== memory true-positive (seeded-bad badmem demo) =="
 # The memory rules must still FIND the failure they were built to
 # kill: the undonated, remat-free long-chain demo must report exactly
@@ -136,6 +91,22 @@ python - <<'PY' || { echo "badmem demo rule set drifted:"; cat /tmp/_badmem.json
 import json
 rules = {f["rule"] for f in json.load(open("/tmp/_badmem.json"))}
 assert rules == {"RKT801", "RKT802", "RKT804"}, rules
+PY
+
+echo "== repro true-positive (seeded-bad badrepro demo) =="
+# The determinism rules must still FIND what they were built to kill:
+# the seeded reused key + unfolded loop key + non-unique float scatter
+# demo must report exactly RKT901 and RKT902 — no more (rule precision)
+# and no less (rule sensitivity).
+if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis repro \
+        --target badrepro --format json >/tmp/_badrepro.json 2>&1; then
+    echo "badrepro demo reported no findings - rules are broken"
+    exit 1
+fi
+python - <<'PY' || { echo "badrepro demo rule set drifted:"; cat /tmp/_badrepro.json; exit 1; }
+import json
+rules = {f["rule"] for f in json.load(open("/tmp/_badrepro.json"))}
+assert rules == {"RKT901", "RKT902"}, rules
 PY
 
 echo "== obs smoke (telemetry + health sentinels + strict step path) =="

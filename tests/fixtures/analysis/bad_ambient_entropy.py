@@ -1,0 +1,19 @@
+"""RKT113 true positives: ambient entropy baked into a traced step."""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped_step(x):
+    started = time.time()  # BAD: the clock is a trace-time constant
+    return x + jnp.float32(started)
+
+
+@jax.jit
+def salted_step(x, table):
+    salt = hash("step-salt")  # BAD: PYTHONHASHSEED randomizes this
+    seed = os.urandom(4)  # BAD: fresh entropy every build
+    return x * jnp.float32(salt % 1024) + jnp.float32(len(seed))

@@ -1,0 +1,23 @@
+"""RKT112 true positives: hash-order iteration reaching the trace."""
+import jax
+import jax.numpy as jnp
+
+
+def assemble_params(shapes):
+    leaves = []
+    for name in {"wte", "wpe", "head"}:  # BAD: set iterated unsorted
+        leaves.append((name, jnp.zeros(shapes[name])))
+    return dict(leaves)
+
+
+def dedup_rules(patterns):
+    return list(set(patterns))  # BAD: list(set(...)) keeps unstable order
+
+
+@jax.jit
+def step(x, scale_by):
+    total = x
+    keys = set(scale_by)
+    for key in keys:  # BAD: inferred set var iterated inside jit
+        total = total * scale_by[key]
+    return total

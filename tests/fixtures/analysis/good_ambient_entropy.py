@@ -1,0 +1,18 @@
+"""RKT113 clean negatives: explicit seeds/keys; host telemetry stays host."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def keyed_step(x, key):
+    noise = jax.random.normal(key, x.shape)  # keyed RNG, reproducible
+    return x + noise
+
+
+def timed_host_loop(step_fn, x, key):
+    # Host-side telemetry timestamps never enter the traced program.
+    started = time.time()
+    y = step_fn(x, key)
+    return y, time.time() - started

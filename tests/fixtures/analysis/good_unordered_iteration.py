@@ -1,0 +1,29 @@
+"""RKT112 clean negatives: sorted() pins every order before it matters."""
+import jax
+import jax.numpy as jnp
+
+
+def assemble_params(shapes):
+    leaves = []
+    for name in sorted({"wte", "wpe", "head"}):  # sorted set: stable
+        leaves.append((name, jnp.zeros(shapes[name])))
+    return dict(leaves)
+
+
+def dedup_rules(patterns):
+    return sorted(set(patterns))  # sorted dedup: stable
+
+
+@jax.jit
+def step(x, scale_by):
+    total = x
+    for key in sorted(set(scale_by)):  # sorted before the trace sees it
+        total = total * scale_by[key]
+    return total
+
+
+def insertion_ordered(config):
+    # dict displays / dicts iterate in insertion order — deterministic.
+    for key in {"a": 1, "b": 2}:
+        config.setdefault(key, 0)
+    return config

@@ -47,6 +47,8 @@ AST_CASES = [
     ("RKT109", "unlocked_mutation"),
     ("RKT110", "swallowed_interrupt"),
     ("RKT111", "undonated_jit_state"),
+    ("RKT112", "unordered_iteration"),
+    ("RKT113", "ambient_entropy"),
 ]
 
 

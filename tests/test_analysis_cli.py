@@ -4,7 +4,8 @@
 (the SPMD auditor), ``... prec`` (the dtype-flow auditor), ``... sched``
 (the roofline/schedule auditor), ``... serve`` (the serving-path
 auditor), ``... calib`` (measured-vs-predicted calibration) and
-``... mem`` (the HBM liveness auditor) must hold the same machine
+``... mem`` (the HBM liveness auditor), ``... repro`` (the determinism
+auditor) and the ``... all`` umbrella must hold the same machine
 contract CI scripts depend on: exit
 0 on a clean tree, 1 on findings, 2 on usage errors, and one
 ``--format json`` output shape. The audit
@@ -60,13 +61,14 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_eight_families():
+def test_list_rules_includes_all_nine_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
-    for rule_id in ("RKT101", "RKT108", "RKT109", "RKT111", "RKT201",
+    for rule_id in ("RKT101", "RKT108", "RKT109", "RKT111", "RKT112",
+                    "RKT113", "RKT201",
                     "RKT301", "RKT306", "RKT401", "RKT406", "RKT501",
                     "RKT506", "RKT601", "RKT606", "RKT701", "RKT703",
-                    "RKT801", "RKT805"):
+                    "RKT801", "RKT805", "RKT901", "RKT906"):
         assert rule_id in proc.stdout
 
 
@@ -78,11 +80,11 @@ def test_audit_registry_covers_every_subcommand():
     from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
 
     assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve",
-                                      "calib", "mem"}
+                                      "calib", "mem", "repro"}
 
 
 @pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve",
-                                 "calib", "mem"])
+                                 "calib", "mem", "repro"])
 def test_every_audit_subcommand_holds_the_usage_contract(sub):
     assert run_cli(sub, "--target", "nope").returncode == 2
     assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
@@ -106,6 +108,7 @@ DEMO_EXPECTED = {
     ("serve", "badserve"): {"RKT601", "RKT602", "RKT603", "RKT604",
                             "RKT605"},
     ("mem", "badmem"): {"RKT801", "RKT802", "RKT804"},
+    ("repro", "badrepro"): {"RKT901", "RKT902"},
 }
 
 
@@ -440,3 +443,136 @@ def test_sched_budget_regression_fails_and_rebaseline_clears(tmp_path):
     proc = run_cli("sched", "--target", "tp_2x4",
                    "--budgets", str(budgets_dir))
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- repro form --------------------------------------------------------------
+
+REPRO_BUDGETS = os.path.join(REPO, "tests", "fixtures", "budgets", "repro")
+
+
+def test_repro_list_targets():
+    proc = run_cli("repro", "--list-targets")
+    assert proc.returncode == 0
+    for name in ("tp_1x8", "fsdp_1x8", "dp_resnet_1x8", "moe",
+                 "charlm_wave", "gpt2_sentinel", "badrepro"):
+        assert name in proc.stdout
+    assert "[demo]" in proc.stdout
+    # Each row names which harness audits it.
+    assert "kind=train" in proc.stdout
+    assert "kind=serve" in proc.stdout
+    assert "kind=exec" in proc.stdout
+
+
+def test_repro_sentinel_proves_bitwise_replay():
+    """RKT905 every CI run: the sentinel step EXECUTES twice from
+    identical donated state and must replay bit-for-bit — this is the
+    one dynamic leg of the determinism audit, cheap enough to never be
+    slow-tiered."""
+    proc = run_cli("repro", "--target", "gpt2_sentinel",
+                   "--budgets", REPRO_BUDGETS)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_repro_self_gate_is_clean_and_budgets_hold():
+    """THE acceptance gate: key discipline, compiled determinism,
+    resume-identity and wave-replay proofs over every real target, with
+    the committed fingerprint budgets — zero findings, exit 0."""
+    proc = run_cli("repro", "--budgets", REPRO_BUDGETS, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_repro_fingerprint_drift_fails_and_rebaseline_clears(tmp_path):
+    """Diff mode: tamper with the committed program fingerprint (a
+    string identity, not a monotone cost) -> RKT906, exit 1;
+    --update-budgets re-baselines and the same diff passes."""
+    budgets_dir = tmp_path / "repro"
+    budgets_dir.mkdir()
+    committed = json.load(
+        open(os.path.join(REPRO_BUDGETS, "gpt2_sentinel.json"))
+    )
+    committed["program_fingerprint"] = "0" * 16
+    (budgets_dir / "gpt2_sentinel.json").write_text(json.dumps(committed))
+
+    proc = run_cli("repro", "--target", "gpt2_sentinel",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 1
+    assert "RKT906" in proc.stdout
+    assert "program_fingerprint" in proc.stdout
+
+    proc = run_cli("repro", "--target", "gpt2_sentinel",
+                   "--budgets", str(budgets_dir), "--update-budgets")
+    assert proc.returncode == 0
+
+    proc = run_cli("repro", "--target", "gpt2_sentinel",
+                   "--budgets", str(budgets_dir))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- budgets <-> targets bijection -------------------------------------------
+
+def test_budget_files_match_registered_targets():
+    """No stale budget file may name a target that no longer exists (a
+    deleted target would otherwise keep gating nothing, silently), and
+    demo targets never get budget files. The repro family additionally
+    holds an exact bijection: every non-demo target has a committed
+    fingerprint baseline."""
+    from rocket_tpu.analysis import budgets as budgets_mod
+    from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
+
+    for sub, cli in AUDIT_SUBCOMMANDS.items():
+        targets, _run = cli.load()
+        family_dir = os.path.join(
+            REPO, getattr(budgets_mod, cli.budgets_dir_attr)
+        )
+        committed = {
+            os.path.splitext(f)[0] for f in os.listdir(family_dir)
+            if f.endswith(".json")
+        }
+        non_demo = {n for n, t in targets.items() if not t.demo}
+        stale = committed - non_demo
+        assert not stale, f"{sub}: stale/demo budget files {sorted(stale)}"
+    from rocket_tpu.analysis.repro_audit import REPRO_TARGETS
+
+    repro_committed = {
+        os.path.splitext(f)[0]
+        for f in os.listdir(os.path.join(REPO, budgets_mod.REPRO_DIR))
+        if f.endswith(".json")
+    }
+    repro_non_demo = {n for n, t in REPRO_TARGETS.items() if not t.demo}
+    assert repro_committed == repro_non_demo
+
+
+# -- the `all` umbrella ------------------------------------------------------
+
+def test_all_usage_errors_exit_two():
+    assert run_cli("all", "--no-such-flag").returncode == 2
+
+
+@pytest.mark.slow
+def test_all_lints_given_paths_with_merged_findings():
+    """The umbrella's lint leg (bad fixture, no budgets): findings from
+    rocketlint surface through the same JSON shape and exit 1. Slow:
+    `all` always sweeps every audit family too, so even the lint-leg
+    assertion costs a full seven-family compile pass — scripts/check.sh
+    exercises the umbrella on every CI run regardless."""
+    proc = run_cli("all", os.path.join(FIXTURES, "bad_tracer_leak.py"),
+                   "--format", "json", timeout=1200)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    findings = json.loads(proc.stdout)
+    assert any(f["rule"] == "RKT101" for f in findings)
+    assert set(findings[0]) == {"rule", "path", "line", "message"}
+
+
+@pytest.mark.slow
+def test_all_self_gate_is_clean_with_budgets_and_report(tmp_path):
+    """One invocation instead of seven: rocketlint + every audit family
+    against the committed budgets — exit 0, and the --json-report
+    artifact is written (an empty list when clean)."""
+    report = tmp_path / "report.json"
+    proc = run_cli("all", "--budgets",
+                   os.path.join("tests", "fixtures", "budgets"),
+                   "--json-report", str(report), timeout=1800)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(report.read_text()) == []

@@ -1,0 +1,208 @@
+"""Unit tests for the determinism auditor (RKT9xx).
+
+The CLI-level contract (targets list, budget gate, badrepro demo,
+`analysis all`) lives in tests/test_analysis_cli.py; this file exercises
+the building blocks in-process: the PRNG-key provenance walker, the
+jaxpr-level nondeterministic-scatter scan, the canonical fingerprints,
+the string-valued (fingerprint) branch of the budget differ, and the
+replay sentinel.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rocket_tpu.analysis.budgets import REPRO_GATED_KEYS, diff_budget
+from rocket_tpu.analysis.repro_audit import (
+    analyze_key_provenance,
+    hlo_fingerprint,
+    jaxpr_fingerprint,
+    run_replay_sentinel,
+    scan_nondet_jaxpr,
+)
+from rocket_tpu.analysis.rules.repro_rules import (
+    check_key_reuse,
+    check_nondet_hlo,
+)
+
+
+def key_findings(fn, *args):
+    flow = analyze_key_provenance(jax.make_jaxpr(fn)(*args))
+    return check_key_reuse(flow.consumptions, flow.unfolded), flow
+
+
+# -- RKT901: key-provenance walker -------------------------------------------
+
+
+def test_key_reuse_fires_on_double_consumption():
+    def step(key, x):
+        a = jax.random.normal(key, x.shape)
+        b = jax.random.uniform(key, x.shape)  # same key value again
+        return x + a * b
+
+    findings, flow = key_findings(step, jax.random.key(0), jnp.ones(4))
+    assert [f.rule for f in findings] == ["RKT901"]
+    assert "consumed by 2" in findings[0].message
+    assert flow.n_consumers == 2
+
+
+def test_split_keys_are_clean():
+    def step(key, x):
+        k1, k2 = jax.random.split(key)
+        return x + jax.random.normal(k1, x.shape) * jax.random.uniform(
+            k2, x.shape
+        )
+
+    findings, flow = key_findings(step, jax.random.key(0), jnp.ones(4))
+    assert findings == []
+    assert flow.n_derivations >= 1
+
+
+def test_unfolded_loop_key_fires():
+    # The closure key enters the scan body unchanged: every iteration
+    # draws the SAME noise — the classic silent-correlation bug.
+    def step(key, xs):
+        def body(acc, x):
+            return acc + jax.random.normal(key, x.shape) * x, None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros(4), xs)
+        return acc
+
+    findings, flow = key_findings(step, jax.random.key(0), jnp.ones((3, 4)))
+    assert any("loop" in f.message for f in findings), [
+        f.message for f in findings
+    ]
+    assert all(f.rule == "RKT901" for f in findings)
+    assert flow.unfolded
+
+
+def test_fold_in_with_loop_carry_is_clean():
+    def step(key, xs):
+        def body(carry, x):
+            i, acc = carry
+            k = jax.random.fold_in(key, i)
+            return (i + 1, acc + jax.random.normal(k, x.shape) * x), None
+
+        (_, acc), _ = jax.lax.scan(body, (0, jnp.zeros(4)), xs)
+        return acc
+
+    findings, _ = key_findings(step, jax.random.key(0), jnp.ones((3, 4)))
+    assert findings == []
+
+
+def test_cond_branches_do_not_double_count():
+    # Only ONE branch executes per call: feeding the same key to both
+    # branches of a cond is a single consumption, not reuse.
+    def step(pred, key, x):
+        return jax.lax.cond(
+            pred,
+            lambda k: jax.random.normal(k, x.shape),
+            lambda k: jax.random.uniform(k, x.shape),
+            key,
+        )
+
+    findings, _ = key_findings(
+        step, jnp.bool_(True), jax.random.key(0), jnp.ones(4)
+    )
+    assert findings == []
+
+
+# -- RKT902: nondeterministic-scatter scan (jaxpr level) ---------------------
+
+
+def test_float_scatter_add_without_unique_indices_fires():
+    def grad_like(table, idx, upd):
+        return table.at[idx].add(upd)
+
+    closed = jax.make_jaxpr(grad_like)(
+        jnp.zeros(8), jnp.array([1, 1, 2]), jnp.ones(3)
+    )
+    ops = scan_nondet_jaxpr(closed)
+    assert len(ops) == 1 and ops[0][0] == "scatter"
+    assert check_nondet_hlo(ops)[0].rule == "RKT902"
+
+
+def test_unique_indices_and_int_scatters_are_clean():
+    def unique(table, idx, upd):
+        return table.at[idx].add(upd, unique_indices=True)
+
+    def integer(table, idx, upd):
+        return table.at[idx].add(upd)
+
+    assert scan_nondet_jaxpr(jax.make_jaxpr(unique)(
+        jnp.zeros(8), jnp.array([1, 2, 3]), jnp.ones(3)
+    )) == []
+    # Integer accumulation is associative bit-for-bit: not flagged.
+    assert scan_nondet_jaxpr(jax.make_jaxpr(integer)(
+        jnp.zeros(8, jnp.int32), jnp.array([1, 1]),
+        jnp.ones(2, jnp.int32),
+    )) == []
+
+
+def test_scatter_allowlist_matches_source_site():
+    ops = [(
+        "scatter",
+        "scatter-add@rocket_tpu/models/transformer.py:998 (embed_lookup)",
+        "unique_indices=False (traced program)",
+    )]
+    assert check_nondet_hlo(ops, scatter_allow=()) != []
+    assert check_nondet_hlo(
+        ops, scatter_allow=("rocket_tpu/models/transformer.py",)
+    ) == []
+
+
+# -- canonical fingerprints --------------------------------------------------
+
+
+def fn_a(x):
+    return jnp.tanh(x) * 2.0
+
+
+def fn_b(x):
+    return jnp.sin(x) + 1.0
+
+
+def test_jaxpr_fingerprint_is_stable_and_discriminating():
+    x = jnp.ones((4, 4))
+    fp1 = jaxpr_fingerprint(jax.make_jaxpr(fn_a)(x))
+    fp2 = jaxpr_fingerprint(jax.make_jaxpr(fn_a)(x))
+    assert fp1 == fp2 and len(fp1) == 16
+    assert fp1 != jaxpr_fingerprint(jax.make_jaxpr(fn_b)(x))
+
+
+def test_hlo_fingerprint_is_stable_and_discriminating():
+    x = jnp.ones((4, 4))
+    hlo_a1 = jax.jit(fn_a).lower(x).compile().as_text()
+    hlo_a2 = jax.jit(fn_a).lower(x).compile().as_text()
+    hlo_b = jax.jit(fn_b).lower(x).compile().as_text()
+    assert hlo_fingerprint(hlo_a1) == hlo_fingerprint(hlo_a2)
+    assert hlo_fingerprint(hlo_a1) != hlo_fingerprint(hlo_b)
+
+
+# -- RKT906: the fingerprint (string) branch of the budget differ ------------
+
+
+def test_diff_budget_gates_fingerprints_on_exact_equality():
+    committed = {"program_fingerprint": "a" * 16, "random_consumers": 3}
+    kwargs = dict(
+        keys=REPRO_GATED_KEYS, rule="RKT906", family="repro"
+    )
+    assert diff_budget("t", committed, dict(committed), **kwargs) == []
+    drifted = dict(committed, program_fingerprint="b" * 16)
+    findings = diff_budget("t", committed, drifted, **kwargs)
+    assert [f.rule for f in findings] == ["RKT906"]
+    assert "program_fingerprint" in findings[0].message
+    assert "--update-budgets" in findings[0].message
+
+
+# -- RKT905: replay sentinel -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replay_sentinel_is_bitwise_equal():
+    # The non-slow CLI test already proves this end-to-end through
+    # `analysis repro --target gpt2_sentinel`; this is the in-process
+    # leg so a sentinel regression pinpoints the helper, not the CLI.
+    mismatches, n_leaves = run_replay_sentinel()
+    assert mismatches == []
+    assert n_leaves > 0
