@@ -969,6 +969,56 @@ REPRO_BUDGETS_DIR = os.path.join(
     "tests", "fixtures", "budgets", "repro",
 )
 
+#: Crash-consistency coverage-budget directory the fault auditor
+#: maintains (``python -m rocket_tpu.analysis fault --update-budgets``).
+FAULT_BUDGETS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "fixtures", "budgets", "fault",
+)
+
+
+def fault_audit_summary(budgets_dir=FAULT_BUDGETS_DIR):
+    """The audited crash-consistency coverage record — crash points
+    enumerated across the three checkpoint save paths, supervisor
+    states explored by the model check, signal handlers checked — from
+    the records the fault self-gate verifies every CI run. Coverage
+    fingerprints are identities (any drift fails CI), so this reads
+    the records directly rather than riding :func:`_budget_summary`'s
+    numeric-max headline."""
+    try:
+        from rocket_tpu.analysis import budgets as budgets_mod
+        keys = budgets_mod.FAULT_GATED_KEYS
+        names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(budgets_dir)
+            if f.endswith(".json")
+        )
+        targets = {}
+        for name in names:
+            record = budgets_mod.load_budget(budgets_dir, name)
+            if record is None:
+                continue
+            targets[name] = {
+                key: record.get(key) for key in keys
+                if record.get(key) is not None
+            }
+        if not targets:
+            return None
+        return {
+            "targets": targets,
+            "source": "tests/fixtures/budgets/fault",
+            "crash_points": max(
+                t.get("crash_points") or 0 for t in targets.values()
+            ),
+            "states_explored": max(
+                t.get("states_explored") or 0 for t in targets.values()
+            ),
+            "handlers_checked": max(
+                t.get("handlers_checked") or 0 for t in targets.values()
+            ),
+        }
+    except Exception:  # noqa: BLE001 — emission must never die on this
+        return None
+
 
 def repro_audit_summary(budgets_dir=REPRO_BUDGETS_DIR):
     """The audited determinism record per canonical target — the
@@ -1578,6 +1628,13 @@ def write_detail(results, path=DETAIL_PATH, health=None, serve=None,
         # fingerprints, exact-equality gated in CI) + RNG-discipline
         # counters — the reproducibility claim the bench numbers rest on.
         detail["repro"] = repro
+    fault = fault_audit_summary(FAULT_BUDGETS_DIR)
+    if fault is not None:
+        # The crash-consistency audit's committed coverage (crash
+        # points enumerated, supervisor states explored, handlers
+        # checked — drift-gated in CI): the resume-from-any-crash claim
+        # the goodput numbers rest on.
+        detail["fault"] = fault
     # Atomic replace: a driver timeout mid-dump must not truncate the
     # accumulated record (the corrupt-prior recovery above would then
     # silently discard it on the next run).

@@ -1,6 +1,6 @@
 """CLI: ``python -m rocket_tpu.analysis <paths...>`` | ``shard`` |
 ``prec`` | ``sched`` | ``serve`` | ``calib`` | ``mem`` | ``repro`` |
-``all``.
+``fault`` | ``all``.
 
 Several entry forms, one process contract (exit 0 = clean, 1 = findings,
 2 = usage error) and one ``--format json`` output shape
@@ -44,6 +44,14 @@ Several entry forms, one process contract (exit 0 = clean, 1 = findings,
   compiled ops, the checkpoint resume-identity and serve wave-replay
   fingerprint proofs, the executed bitwise-replay sentinel, and the
   fingerprint budgets;
+* ``fault`` audits the *crash story*
+  (:mod:`rocket_tpu.analysis.fault_audit`): every crash prefix of the
+  journaled filesystem effects in the three checkpoint save paths
+  replayed against ``is_complete_checkpoint`` and resume fallback, the
+  commit-protocol (fsync-before-rename, marker-last) scan, an
+  exhaustive model check plus live-loop conformance of the supervisor
+  transition function, the signal-handler safety scan, and the
+  coverage budgets;
 * ``all`` runs rocketlint plus every family above in one process with
   one merged findings list — the single invocation check.sh/ci.yml
   gate on.
@@ -148,6 +156,15 @@ def _load_repro():
     )
 
     return REPRO_TARGETS, run_repro_target
+
+
+def _load_fault():
+    from rocket_tpu.analysis.fault_audit import (
+        FAULT_TARGETS,
+        run_fault_target,
+    )
+
+    return FAULT_TARGETS, run_fault_target
 
 
 def _mesh_line(target) -> str:
@@ -263,6 +280,21 @@ AUDIT_SUBCOMMANDS: dict[str, AuditCLI] = {
                 + (f" {_mesh_line(t)}" if t.mesh_shape else "")
             ),
         ),
+        AuditCLI(
+            name="fault",
+            description="crash-consistency / failure-path audit: "
+                        "crash-prefix replay of every journaled "
+                        "filesystem effect in the three checkpoint "
+                        "save paths, exhaustive model check + live "
+                        "conformance of the supervisor transition "
+                        "function, signal-handler safety scan",
+            load=_load_fault,
+            budgets_dir_attr="FAULT_DIR",
+            gated_keys_attr="FAULT_GATED_KEYS",
+            budget_rule="RKT1006",
+            family="fault",
+            list_line=lambda t: f"kind={t.kind}",
+        ),
     )
 }
 
@@ -303,13 +335,17 @@ def _sweep_targets(cli: AuditCLI, *, names=None, budgets_dir=None,
 
 def _write_json_report(path: str, findings) -> None:
     """Machine-readable copy of the findings (the ``--format json``
-    shape), written unconditionally so CI can upload it on failure."""
+    shape), written unconditionally so CI can upload it on failure.
+    Temp-then-rename (RKT114): a crash mid-dump must not leave CI a
+    truncated report where the previous complete one stood."""
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with open(path, "w") as fh:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump([asdict(f) for f in findings], fh, indent=2)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def _audit_main(cli: AuditCLI, argv) -> int:

@@ -47,6 +47,7 @@ __all__ = [
     "CALIB_GATED_KEYS",
     "MEM_GATED_KEYS",
     "REPRO_GATED_KEYS",
+    "FAULT_GATED_KEYS",
     "budget_path",
     "load_budget",
     "write_budget",
@@ -104,6 +105,17 @@ MEM_GATED_KEYS = ("predicted_peak_bytes", "saved_activation_bytes")
 #: draw shows up as growth.
 REPRO_GATED_KEYS = ("program_fingerprint", "random_consumers")
 
+#: Record keys the fault (crash-consistency) gate compares — RKT1006.
+#: The counts are coverage metrics, not costs: growth means the save
+#: paths/state machine got bigger (acknowledge via re-baseline), while
+#: the ``coverage_fingerprint`` string key catches the bad direction —
+#: ANY drift, including a SHRINKING crash-point or explored-state
+#: count, fails until someone re-baselines: the audit must never get
+#: quietly weaker. Each fault target's record carries its own subset
+#: (the diff loop skips keys absent from either side).
+FAULT_GATED_KEYS = ("crash_points", "states_explored",
+                    "handlers_checked", "coverage_fingerprint")
+
 #: Default budgets directory, resolved relative to the repo checkout.
 #: The precision/schedule/serving budgets live in ``prec/`` / ``sched/``
 #: / ``serve/`` subdirectories so BENCH's per-target sweep over
@@ -115,6 +127,7 @@ SERVE_DIR = os.path.join(DEFAULT_DIR, "serve")
 CALIB_DIR = os.path.join(DEFAULT_DIR, "calib")
 MEM_DIR = os.path.join(DEFAULT_DIR, "mem")
 REPRO_DIR = os.path.join(DEFAULT_DIR, "repro")
+FAULT_DIR = os.path.join(DEFAULT_DIR, "fault")
 
 
 def budget_path(budgets_dir: str, target: str) -> str:
@@ -164,6 +177,7 @@ def diff_budget(
     subcommand = {
         "spmd": "shard", "sched": "sched", "serve": "serve",
         "calib": "calib", "mem": "mem", "repro": "repro",
+        "fault": "fault",
     }.get(family, "prec")
     if committed is None:
         return [Finding(

@@ -1,0 +1,981 @@
+"""Crash-consistency & failure-path auditor — the `fault` subcommand.
+
+The resilience layer (supervisor, drain, emergency checkpoint) makes
+claims about interleavings nobody can exhaustively test dynamically:
+"a crash at ANY point during a save leaves either the previous
+complete checkpoint or the new one, never a restorable lie", "the
+supervision loop terminates under every outcome sequence", "the
+signal handlers can land on any instruction without deadlocking".
+This module proves them statically, three ways:
+
+**Crash-point enumeration** (`ckpt_protocol` target). A recording
+filesystem shim (:class:`RecordingFS`, interposed via
+:func:`rocket_tpu.runtime.checkpoint_io.use_fs`) journals every
+durable effect — makedirs / mktemp / write / fsync / replace — that
+``Checkpointer.save``, ``save_drain`` and ``save_emergency`` perform
+against a real checkpointer writing real state. Every crash prefix of
+each journal is then materialized into a fresh directory and judged:
+``is_complete_checkpoint`` must reject the torn states,
+``newest_complete_step`` must keep resolving to the last pre-existing
+complete step until the new save's completeness marker commits, and
+any ACCEPTED state must be byte-identical (over the completeness
+closure) to the finished save (RKT1001). The journal itself is
+scanned for commit-protocol violations: rename without fsync of the
+temp, payload effects after the ``rng.json`` marker (RKT1002).
+Coverage is total by construction — ``len(journal) + 1`` prefixes per
+path — and counted into the budget record so it can only shrink
+deliberately.
+
+**Supervisor model check** (`supervisor_model` target). The
+restart/degrade/crash-loop logic lives in ONE pure function —
+:func:`rocket_tpu.resilience.supervisor.decide` — shared by the live
+loop and this checker. The checker drives it through every outcome
+sequence over an 8-event alphabet (complete / drain-with- and
+without-checkpoint / progressing and non-progressing crash / wedge /
+coordinator error / crash-under-drain) to depth >= 6 via memoized
+reachability (decide is deterministic, so equal states have equal
+futures and the reachable graph — bounded by the restart budget — is
+explored exactly once per state while covering ALL |alphabet|^depth
+sequences). Per-transition invariants: the restart counter increments
+by exactly one per continue and never exceeds the budget, nproc is
+monotone non-increasing and never below ``min_procs``, rc-0 stops
+are only ``completed``/``drained``, drained-rc-0 requires a complete
+checkpoint when a probe is configured, and the failure counters stay
+below their thresholds on every continue (RKT1003). Reachability:
+all five terminal outcomes must be expressible and every reachable
+state must terminate under a sustained crash flood (RKT1004). A
+conformance leg then replays scripted outcome sequences through the
+real :class:`~rocket_tpu.resilience.supervisor.Supervisor` event loop
+and asserts the live terminal verdict and goodput accounting
+(``productive <= total``, fraction in [0, 1]) match the model.
+
+**Signal-handler safety** (`signal_handlers` target). Every
+``signal.signal(sig, handler)`` installation in the package is found
+by AST walk and the handler body (plus one hop of same-file calls) is
+checked against an async-signal-safe allowlist: flag sets and signal
+re-dispositions are fine; logging, printing, I/O and lock acquisition
+are RKT1005 — a signal landing while the interrupted thread holds the
+logging lock deadlocks the process.
+
+The `badfault` demo target seeds the diseases: a save path that
+commits the completeness marker FIRST (no fsync, payload after the
+marker) and a supervisor transition function that certifies a drained
+stop without any durable checkpoint — the CI true-positive leg
+asserts exactly {RKT1001, RKT1002, RKT1003} fire.
+
+RKT1006 gates the coverage record against
+``tests/fixtures/budgets/fault/`` via the shared diff loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from rocket_tpu.analysis.findings import Finding
+from rocket_tpu.analysis.rules.fault_rules import (
+    check_atomic_commit,
+    check_crash_prefixes,
+    check_invariants,
+    check_reachability,
+    check_signal_handlers,
+)
+from rocket_tpu.resilience.supervisor import (
+    Decision,
+    GenEvent,
+    LoopState,
+    RestartPolicy,
+    Supervisor,
+    decide,
+    is_complete_checkpoint,
+    newest_complete_step,
+)
+from rocket_tpu.runtime import checkpoint_io
+
+__all__ = [
+    "RecordingFS",
+    "FaultTarget",
+    "FaultAuditReport",
+    "FAULT_TARGETS",
+    "EVENT_ALPHABET",
+    "TERMINAL_OUTCOMES",
+    "capture_save_journals",
+    "replay_crash_prefixes",
+    "model_check",
+    "conformance_check",
+    "scan_signal_handlers",
+    "audit_checkpoint_protocol",
+    "audit_supervisor_model",
+    "audit_signal_handlers",
+    "run_fault_target",
+]
+
+
+# -- the recording filesystem shim -------------------------------------------
+
+
+class RecordingFS(checkpoint_io.HostFS):
+    """A :class:`~rocket_tpu.runtime.checkpoint_io.HostFS` that performs
+    every effect for real AND journals it (root-relative paths, write
+    payloads included) so the exact sequence can be replayed prefix by
+    prefix. Temp names are deterministic (``.wip<n>.tmp``) so a journal
+    replays into any directory."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.journal: list[tuple] = []
+        self._n = 0
+
+    def _rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.journal.append(("makedirs", self._rel(path)))
+
+    def mktemp(self, directory: str, suffix: str = ".tmp") -> str:
+        self._n += 1
+        tmp = os.path.join(directory, f".wip{self._n}{suffix}")
+        with open(tmp, "wb"):
+            pass
+        self.journal.append(("mktemp", self._rel(tmp)))
+        return tmp
+
+    def write(self, path: str, data: bytes) -> None:
+        with open(path, "wb") as f:
+            f.write(data)
+        self.journal.append(("write", self._rel(path), bytes(data)))
+
+    def fsync(self, path: str) -> None:
+        # Durability ordering is what the journal records; actually
+        # syncing a scratch directory would only slow the audit down.
+        self.journal.append(("fsync", self._rel(path)))
+
+    def replace(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+        self.journal.append(("replace", self._rel(src), self._rel(dst)))
+
+
+# -- a minimal runtime for the real Checkpointer -----------------------------
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullTelemetry:
+    def span(self, name, cat=None):
+        return _NullSpan()
+
+
+class _FakeModel:
+    def __init__(self, state):
+        self.state = state
+
+
+class _FakeRuntime:
+    """Just enough runtime for Checkpointer.save/save_drain/
+    save_emergency: single-process, numpy state, no collectives."""
+
+    is_main_process = True
+
+    def __init__(self) -> None:
+        self.models = {
+            "model": _FakeModel({
+                "params": np.arange(16.0).reshape(4, 4),
+                "step": np.int64(3),
+            })
+        }
+        self.telemetry = _NullTelemetry()
+        self.checkpoint_stack = []
+        self.checkpointers = []
+
+    def wait_for_everyone(self) -> None:
+        pass
+
+    def rng_state_dict(self) -> dict:
+        return {"counter": 7}
+
+
+def _make_checkpointer(outdir: str):
+    from rocket_tpu.core.checkpoint import Checkpointer
+
+    return Checkpointer(
+        output_dir=outdir, save_every=1, runtime=_FakeRuntime()
+    )
+
+
+SEED_STEP = 1
+TARGET_STEP = 2
+
+
+def capture_save_journals(tmpdir: str) -> dict:
+    """Run all three save paths of a real Checkpointer under the
+    recording shim. Returns ``{path_name: (journal, output_dir)}``;
+    each ``output_dir`` holds a pre-seeded complete ``SEED_STEP``
+    checkpoint (written OUTSIDE the recording — the fallback target)
+    plus the recorded ``TARGET_STEP`` save."""
+    journals: dict = {}
+
+    def record(name, go):
+        outdir = os.path.join(tmpdir, name)
+        ckpt = _make_checkpointer(outdir)
+        ckpt.save(step=SEED_STEP)
+        ckpt._writer.wait()
+        rec = RecordingFS(outdir)
+        with checkpoint_io.use_fs(rec):
+            go(ckpt)
+        journals[name] = (rec.journal, outdir)
+
+    def go_save(ckpt):
+        ckpt.save(step=TARGET_STEP)
+        ckpt._writer.wait()  # inside use_fs: the async write must land
+
+    def go_drain(ckpt):
+        ckpt._iter_idx = TARGET_STEP
+        ckpt.save_drain()
+
+    def go_emergency(ckpt):
+        ckpt.save_emergency(
+            os.path.join(ckpt._output_dir, str(TARGET_STEP))
+        )
+
+    record("save", go_save)
+    record("save_drain", go_drain)
+    record("save_emergency", go_emergency)
+    return journals
+
+
+# -- crash-prefix replay -----------------------------------------------------
+
+
+def _apply_effects(journal, k: int, dest_root: str) -> None:
+    for effect in journal[:k]:
+        op = effect[0]
+        if op == "makedirs":
+            os.makedirs(os.path.join(dest_root, effect[1]), exist_ok=True)
+        elif op == "mktemp":
+            path = os.path.join(dest_root, effect[1])
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb"):
+                pass
+        elif op == "write":
+            path = os.path.join(dest_root, effect[1])
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(effect[2])
+        elif op == "fsync":
+            pass
+        elif op == "replace":
+            os.replace(
+                os.path.join(dest_root, effect[1]),
+                os.path.join(dest_root, effect[2]),
+            )
+        else:  # pragma: no cover - the shim only emits the five ops
+            raise ValueError(f"unknown journal op {op!r}")
+
+
+def _completeness_closure(step_dir: str) -> dict:
+    """{relative path: bytes} of every file ``is_complete_checkpoint``
+    covers in ``step_dir``: rng.json plus each model dir's index and
+    every shard file the index references."""
+    out: dict = {}
+
+    def grab(rel):
+        with open(os.path.join(step_dir, rel), "rb") as f:
+            out[rel] = f.read()
+
+    grab("rng.json")
+    for entry in sorted(os.listdir(step_dir)):
+        model_dir = os.path.join(step_dir, entry)
+        if not (entry.startswith("model_") and os.path.isdir(model_dir)):
+            continue
+        grab(os.path.join(entry, "index.json"))
+        with open(os.path.join(model_dir, "index.json"),
+                  encoding="utf-8") as f:
+            index = json.load(f)
+        files = {
+            chunk["file"]
+            for meta in index.values()
+            if meta.get("kind") == "array"
+            for chunk in meta["chunks"]
+        }
+        for name in sorted(files):
+            grab(os.path.join(entry, name))
+    return out
+
+
+def replay_crash_prefixes(
+    journal,
+    scratch: str,
+    *,
+    seed_dir: Optional[str] = None,
+    target_step: int = TARGET_STEP,
+    seed_step: int = SEED_STEP,
+) -> list[dict]:
+    """Materialize every crash prefix of ``journal`` and judge it.
+
+    Returns one verdict dict per prefix (the
+    :func:`~rocket_tpu.analysis.rules.fault_rules.check_crash_prefixes`
+    input shape). ``seed_dir``, when given, is a complete earlier-step
+    checkpoint copied in first — the state resume must fall back to
+    while the target is torn.
+    """
+    n = len(journal)
+    # The finished save defines the byte-truth an accepted state must
+    # match over the completeness closure.
+    final_root = os.path.join(scratch, "final")
+    if seed_dir is not None:
+        shutil.copytree(seed_dir, os.path.join(final_root, str(seed_step)))
+    _apply_effects(journal, n, final_root)
+    final_target = os.path.join(final_root, str(target_step))
+    final_closure = (
+        _completeness_closure(final_target)
+        if is_complete_checkpoint(final_target) else {}
+    )
+
+    verdicts = []
+    for k in range(n + 1):
+        dest_root = os.path.join(scratch, f"prefix{k}")
+        if seed_dir is not None:
+            shutil.copytree(
+                seed_dir, os.path.join(dest_root, str(seed_step))
+            )
+        else:
+            os.makedirs(dest_root, exist_ok=True)
+        _apply_effects(journal, k, dest_root)
+        target_dir = os.path.join(dest_root, str(target_step))
+        complete = is_complete_checkpoint(target_dir)
+        consistent = True
+        if complete:
+            for rel, data in final_closure.items():
+                path = os.path.join(target_dir, rel)
+                if not os.path.exists(path):
+                    consistent = False
+                    break
+                with open(path, "rb") as f:
+                    if f.read() != data:
+                        consistent = False
+                        break
+            if not final_closure:
+                consistent = False  # accepted, yet the finished save isn't
+            if consistent:
+                # The accepted state must also actually load.
+                try:
+                    for entry in sorted(os.listdir(target_dir)):
+                        model_dir = os.path.join(target_dir, entry)
+                        if entry.startswith("model_") and \
+                                os.path.isdir(model_dir):
+                            checkpoint_io.load_pytree(model_dir)
+                except Exception:
+                    consistent = False
+        fallback = newest_complete_step(dest_root)
+        expected = (
+            target_step if complete
+            else (seed_step if seed_dir is not None else None)
+        )
+        verdicts.append({
+            "k": k,
+            "complete": complete,
+            "consistent": consistent,
+            "fallback_ok": fallback == expected,
+            "fallback_step": fallback,
+            "final": k == n,
+        })
+    return verdicts
+
+
+# -- supervisor model check --------------------------------------------------
+
+
+#: Every way a generation can end, from the decision logic's point of
+#: view. Exhaustive over the GenEvent fields that reach distinct decide
+#: branches (probe=True throughout — the probe-less variant is covered
+#: by the drained-with-checkpoint row, which takes the same branch).
+EVENT_ALPHABET = (
+    GenEvent("completed"),
+    GenEvent("drained", complete_ckpt=True),
+    GenEvent("drained", complete_ckpt=False),
+    GenEvent("crashed", progressed=True, complete_ckpt=True),
+    GenEvent("crashed"),
+    GenEvent("wedged"),
+    GenEvent("crashed", coord_error=True),
+    GenEvent("crashed", drain_requested=True),
+)
+
+TERMINAL_OUTCOMES = (
+    "completed", "drained", "drain_failed", "crash_loop",
+    "restart_budget_exhausted",
+)
+
+MODEL_DEPTH = 6
+
+
+def _check_transition(state: LoopState, policy: RestartPolicy,
+                      event: GenEvent, d: Decision, violations: dict) -> None:
+    """The RKT1003 invariants, asserted on one (state, event) edge.
+    Violations are keyed by (invariant, event identity) so each failure
+    mode reports once, with the first offending state as evidence."""
+
+    def bad(name, detail):
+        violations.setdefault(
+            (name, event), f"{name}: {detail} [event={event.outcome}"
+            f"{' +drain' if event.drain_requested else ''}"
+            f"{' +progress' if event.progressed else ''}"
+            f"{' +coord' if event.coord_error else ''}, first at {state}]"
+        )
+
+    if d.state.nproc > state.nproc or d.state.nproc < policy.min_procs:
+        bad("nproc-floor", "worker count left [min_procs, current] — "
+            f"{state.nproc} -> {d.state.nproc}")
+    if d.rc_zero and d.outcome not in ("completed", "drained"):
+        bad("rc-zero", f"exit 0 certified for outcome {d.outcome!r}")
+    if d.outcome == "drained" and event.probe and not event.complete_ckpt:
+        bad("drained-without-checkpoint",
+            "a drained rc-0 stop was certified with no complete "
+            "checkpoint under the probe")
+    if d.stop and d.outcome not in TERMINAL_OUTCOMES:
+        bad("unknown-terminal", f"stop with outcome {d.outcome!r}")
+    if not d.stop:
+        if d.state.restarts != state.restarts + 1:
+            bad("restart-monotonic",
+                "the restart counter must increment by exactly one per "
+                f"continue — {state.restarts} -> {d.state.restarts}")
+        if state.restarts >= policy.max_restarts:
+            bad("restart-budget",
+                f"continued with the budget exhausted ({state.restarts} "
+                f">= {policy.max_restarts})")
+        if d.state.consecutive_failures >= policy.crash_loop_threshold:
+            bad("crash-loop-cap",
+                "continued with the failure streak at/over the "
+                f"threshold ({d.state.consecutive_failures})")
+        if (d.state.failures_at_nproc >= policy.degrade_after
+                and d.state.nproc > policy.min_procs):
+            bad("degrade-cap",
+                "continued above the floor with failures_at_nproc at/"
+                f"over degrade_after ({d.state.failures_at_nproc})")
+    if min(d.state.restarts, d.state.consecutive_failures,
+           d.state.failures_at_nproc) < 0:
+        bad("counter-sign", f"negative counter in {d.state}")
+
+
+def model_check(
+    policy: Optional[RestartPolicy] = None,
+    *,
+    nproc: int = 3,
+    depth: int = MODEL_DEPTH,
+    decide_fn: Callable = decide,
+    alphabet=EVENT_ALPHABET,
+) -> dict:
+    """Exhaustive bounded model check of the supervision state machine.
+
+    ``decide_fn`` is deterministic, so memoized reachability covers
+    every event sequence (all ``len(alphabet) ** depth`` of them, and
+    in fact every length — the reachable graph is finite because each
+    continue increments the restart counter toward the budget) while
+    evaluating each (state, event) edge exactly once.
+    """
+    policy = policy or RestartPolicy()
+    violations: dict = {}
+    terminals: dict[str, int] = {}
+    init = LoopState(nproc=nproc)
+    seen = {init}
+    frontier = [init]
+    transitions = 0
+    level = 0
+    max_level_needed = 0
+    while frontier:
+        level += 1
+        nxt = []
+        for state in frontier:
+            for event in alphabet:
+                transitions += 1
+                d = decide_fn(state, policy, event)
+                _check_transition(state, policy, event, d, violations)
+                if d.stop:
+                    terminals[d.outcome] = terminals.get(d.outcome, 0) + 1
+                elif d.state not in seen:
+                    seen.add(d.state)
+                    nxt.append(d.state)
+        frontier = nxt
+        if frontier:
+            max_level_needed = level
+    if max_level_needed + 1 < depth:
+        # The graph closed before the requested depth — fine (the
+        # memoization already certifies all deeper sequences), but the
+        # claim "explored to depth >= N" must still be honest.
+        pass
+
+    # Livelock sweep: from EVERY reachable state, a sustained
+    # no-progress crash flood must reach a terminal verdict.
+    flood = GenEvent("crashed")
+    cap = (
+        policy.max_restarts + policy.crash_loop_threshold
+        + nproc * max(1, policy.degrade_after) + 4
+    )
+    livelocks = []
+    for state in sorted(
+        seen, key=lambda s: (s.nproc, s.restarts,
+                             s.consecutive_failures, s.failures_at_nproc)
+    ):
+        s = state
+        for _ in range(cap):
+            d = decide_fn(s, policy, flood)
+            if d.stop:
+                break
+            s = d.state
+        else:
+            livelocks.append(str(state))
+
+    return {
+        "violations": list(violations.values()),
+        "terminals": terminals,
+        "livelocks": livelocks,
+        "states_explored": len(seen),
+        "transitions_checked": transitions,
+        "depth": depth,
+        "sequences_at_depth": len(alphabet) ** depth,
+        "graph_closed_at": max_level_needed + 1,
+    }
+
+
+def conformance_check(
+    state_dir: str,
+    *,
+    max_len: int = 3,
+    decide_fn: Callable = decide,
+) -> dict:
+    """Drive the REAL Supervisor event loop through scripted outcome
+    sequences and assert its terminal verdict and goodput accounting
+    match the pure transition function — the proof that run() actually
+    consumes decide() rather than shadowing it."""
+    from rocket_tpu.resilience.faults import EXIT_DRAINED, EXIT_WEDGED
+
+    rcs = (0, 1, EXIT_DRAINED, EXIT_WEDGED)
+    policy = RestartPolicy(
+        max_restarts=2, backoff_base_s=0.0, backoff_max_s=0.0,
+        crash_loop_threshold=2, degrade_after=3, min_procs=1,
+    )
+    violations = []
+    runs = 0
+
+    class _Silent:  # keep the 84 scripted runs off the audit's stdout
+        def info(self, *args, **kwargs):
+            pass
+
+    silent = _Silent()
+
+    def classify(rc):
+        from rocket_tpu.resilience.supervisor import _classify
+
+        return _classify(rc)
+
+    def predict(script):
+        state = LoopState(nproc=2)
+        for rc in list(script) + [0]:
+            event = GenEvent(outcome=classify(rc), probe=False)
+            d = decide_fn(state, policy, event)
+            if d.stop:
+                return d
+            state = d.state
+        return d  # pragma: no cover - the trailing 0 always stops
+
+    def sequences(length):
+        if length == 0:
+            yield ()
+            return
+        for head in rcs:
+            for tail in sequences(length - 1):
+                yield (head,) + tail
+
+    for length in range(1, max_len + 1):
+        for script in sequences(length):
+            runs += 1
+            pending = list(script)
+
+            def run_generation(gen, nproc, drain_event, on_poll,
+                               _pending=pending):
+                rc = _pending.pop(0) if _pending else 0
+                return rc, [rc], {}
+
+            ticks = [0.0]
+
+            def clock(_ticks=ticks):
+                _ticks[0] += 0.001
+                return _ticks[0]
+
+            sup = Supervisor(
+                nproc=2, script="scripted.py", policy=policy,
+                state_dir=os.path.join(state_dir, f"run{runs}"),
+                run_generation=run_generation,
+                sleep=lambda s: None, clock=clock, logger=silent,
+            )
+            rc = sup.run()
+            want = predict(script)
+            want_rc_zero = want.rc_zero
+            if sup.outcome != want.outcome or (rc == 0) != want_rc_zero:
+                violations.append(
+                    "live-loop divergence: script "
+                    f"{script} ended ({sup.outcome!r}, rc={rc}) but the "
+                    f"transition function predicts ({want.outcome!r}, "
+                    f"rc_zero={want_rc_zero})"
+                )
+            summary = sup.summary()
+            frac = summary["goodput_fraction"]
+            if not (0.0 <= frac <= 1.0 + 1e-6):
+                violations.append(
+                    f"goodput-fraction out of [0, 1]: {frac} for "
+                    f"script {script}"
+                )
+            if summary["productive_wall_s"] > \
+                    summary["total_wall_s"] + 1e-6:
+                violations.append(
+                    "goodput accounting: productive "
+                    f"{summary['productive_wall_s']} exceeds total "
+                    f"{summary['total_wall_s']} for script {script} — "
+                    "the productive/lost split no longer sums to the "
+                    "total wall clock"
+                )
+    return {"violations": violations, "runs": runs}
+
+
+# -- signal-handler safety scan ----------------------------------------------
+
+
+_UNSAFE_CALL_NAMES = {"print", "open", "input", "exec", "eval"}
+_UNSAFE_ATTRS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "write", "flush", "acquire", "release", "wait", "join",
+    "notify", "notify_all", "put", "get",
+}
+_SAFE_ATTRS = {"set", "clear", "is_set", "request", "discard", "add"}
+_SAFE_PREFIXES = ("signal.", "time.", "os.getpid", "os.kill")
+
+
+def _dotted(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    if isinstance(node, ast.Call):
+        # e.g. signal.Signals(signum).name — judge by the inner call.
+        return _dotted(node.func)
+    return None
+
+
+def _scan_body(body, resolve, violations, rel, handler_name,
+               depth: int) -> None:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            head, _, attr = name.rpartition(".")
+            if not head:  # plain function call
+                if name in _UNSAFE_CALL_NAMES:
+                    violations.append(
+                        (rel, node.lineno, handler_name, name))
+                elif depth > 0:
+                    target = resolve(name)
+                    if target is not None:
+                        _scan_body(target.body, resolve, violations, rel,
+                                   handler_name, depth - 1)
+                continue
+            if any(name.startswith(p) or (p.endswith(".") and
+                                          name == p[:-1])
+                   for p in _SAFE_PREFIXES):
+                continue
+            receiver = head.split(".")[-1]
+            if attr in _UNSAFE_ATTRS or "log" in receiver.lower() or \
+                    receiver == "sys":
+                violations.append((rel, node.lineno, handler_name, name))
+                continue
+            if attr in _SAFE_ATTRS:
+                continue
+            if head == "self" and depth > 0:
+                target = resolve(attr)
+                if target is not None:
+                    _scan_body(target.body, resolve, violations, rel,
+                               handler_name, depth - 1)
+            # anything else (closure-captured callables like the chained
+            # previous handler) is opaque — allowed.
+
+
+def scan_signal_handlers(root: str) -> tuple[int, int, list[tuple]]:
+    """AST-scan ``root`` for ``signal.signal(sig, handler)`` sites and
+    check every resolvable handler body (one hop of same-file calls
+    deep) against the async-signal-safe allowlist.
+
+    Returns ``(files_scanned, handlers_checked, violations)`` with
+    violations as ``(path, line, handler_name, call)`` tuples.
+    """
+    files = 0
+    handlers = 0
+    violations: list[tuple] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            files += 1
+            by_name: dict[str, ast.AST] = {}
+            for node in ast.walk(tree):
+                if isinstance(node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    by_name.setdefault(node.name, node)
+            installs = [
+                node for node in ast.walk(tree)
+                if isinstance(node, ast.Call)
+                and _dotted(node.func) == "signal.signal"
+                and len(node.args) >= 2
+            ]
+            for call in installs:
+                handler_arg = call.args[1]
+                if isinstance(handler_arg, ast.Lambda):
+                    handlers += 1
+                    _scan_body([ast.Expr(handler_arg.body)],
+                               by_name.get, violations, rel,
+                               "<lambda>", 1)
+                    continue
+                if not isinstance(handler_arg, ast.Name):
+                    # restoring a saved disposition (previous_int,
+                    # signal.SIG_DFL, ...) — nothing to check
+                    continue
+                target = by_name.get(handler_arg.id)
+                if target is None:
+                    continue
+                handlers += 1
+                _scan_body(target.body, by_name.get, violations, rel,
+                           target.name, 1)
+    return files, handlers, violations
+
+
+# -- the audits --------------------------------------------------------------
+
+
+@dataclass
+class FaultAuditReport:
+    label: str
+    findings: list = field(default_factory=list)
+    record: dict = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def audit_checkpoint_protocol(label: str = "ckpt_protocol"
+                              ) -> FaultAuditReport:
+    """Crash-point enumeration over all three save paths."""
+    report = FaultAuditReport(label)
+    effects: dict[str, int] = {}
+    prefixes_total = 0
+    with tempfile.TemporaryDirectory(prefix="rocket-fault-") as tmpdir:
+        journals = capture_save_journals(os.path.join(tmpdir, "capture"))
+        for name, (journal, outdir) in journals.items():
+            effects[name] = len(journal)
+            scratch = os.path.join(tmpdir, f"replay-{name}")
+            verdicts = replay_crash_prefixes(
+                journal, scratch,
+                seed_dir=os.path.join(outdir, str(SEED_STEP)),
+            )
+            prefixes_total += len(verdicts)
+            # Coverage is asserted, not assumed: every journaled effect
+            # must have produced its crash prefix.
+            if len(verdicts) != len(journal) + 1:
+                report.findings.append(Finding(
+                    "RKT1001", f"<fault:{label}/{name}>", 0,
+                    f"crash-prefix coverage hole: {len(verdicts)} "
+                    f"prefixes for {len(journal)} journaled effects",
+                ))
+            report.findings.extend(check_crash_prefixes(
+                verdicts, label=f"{label}/{name}"))
+            report.findings.extend(check_atomic_commit(
+                journal, label=f"{label}/{name}"))
+    report.record = {
+        "crash_points": prefixes_total,
+        "effects_save": effects.get("save", 0),
+        "effects_save_drain": effects.get("save_drain", 0),
+        "effects_save_emergency": effects.get("save_emergency", 0),
+        "coverage_fingerprint": (
+            f"prefixes={prefixes_total} "
+            + " ".join(f"{k}={v}" for k, v in sorted(effects.items()))
+        ),
+    }
+    return report
+
+
+def audit_supervisor_model(label: str = "supervisor_model"
+                           ) -> FaultAuditReport:
+    """Exhaustive model check + live-loop conformance on the shared
+    transition function."""
+    report = FaultAuditReport(label)
+    facts = model_check()
+    with tempfile.TemporaryDirectory(prefix="rocket-fault-sup-") as tmp:
+        conform = conformance_check(tmp)
+    report.findings.extend(check_invariants(
+        facts["violations"] + conform["violations"], label=label))
+    report.findings.extend(check_reachability(
+        facts["terminals"], TERMINAL_OUTCOMES, facts["livelocks"],
+        label=label))
+    report.record = {
+        "states_explored": facts["states_explored"],
+        "transitions_checked": facts["transitions_checked"],
+        "sequences_at_depth": facts["sequences_at_depth"],
+        "conformance_runs": conform["runs"],
+        "coverage_fingerprint": (
+            f"states={facts['states_explored']} "
+            f"transitions={facts['transitions_checked']} "
+            f"depth={facts['depth']} "
+            f"terminals={len(facts['terminals'])} "
+            f"conformance={conform['runs']}"
+        ),
+    }
+    return report
+
+
+def audit_signal_handlers(label: str = "signal_handlers"
+                          ) -> FaultAuditReport:
+    """RKT1005 over every installed handler in the package."""
+    report = FaultAuditReport(label)
+    package_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    files, handlers, violations = scan_signal_handlers(package_root)
+    report.findings.extend(check_signal_handlers(violations))
+    report.record = {
+        "handlers_checked": handlers,
+        "files_scanned": files,
+        # files_scanned stays OUT of the fingerprint: adding any module
+        # to the package must not fail the fault gate; losing an
+        # installed HANDLER from the scan must.
+        "coverage_fingerprint": f"handlers={handlers}",
+    }
+    return report
+
+
+# -- the seeded-bad demo -----------------------------------------------------
+
+
+def _badfault_journal(root: str) -> list[tuple]:
+    """A save path with the diseases inverted out of the real one: the
+    completeness marker is committed FIRST (by un-fsynced rename), then
+    the payload is written in place AFTER it."""
+    rec = RecordingFS(root)
+    step_dir = os.path.join(root, str(TARGET_STEP))
+    model_dir = os.path.join(step_dir, "model_0")
+    rec.makedirs(step_dir)
+    tmp = rec.mktemp(step_dir)
+    rec.write(tmp, json.dumps({"counter": 7}).encode("utf-8"))
+    rec.replace(tmp, os.path.join(step_dir, "rng.json"))  # no fsync!
+    rec.makedirs(model_dir)
+    rec.write(
+        os.path.join(model_dir, "shard_p0.npz"),
+        checkpoint_io._NpzBytes({"w:0": np.arange(4.0)}).getvalue(),
+    )
+    rec.write(
+        os.path.join(model_dir, "index.json"),
+        json.dumps({
+            "w": {
+                "kind": "array", "shape": [4], "dtype": "float64",
+                "chunks": [{
+                    "file": "shard_p0.npz", "key": "w:0",
+                    "index": [[0, 4]],
+                }],
+            }
+        }).encode("utf-8"),
+    )
+    return rec.journal
+
+
+def _bad_decide(state: LoopState, policy: RestartPolicy,
+                event: GenEvent) -> Decision:
+    """The real transition function, except it certifies a drained rc-0
+    stop even when the probe sees no complete checkpoint — the exact
+    bug the drained-without-checkpoint invariant exists to catch."""
+    d = decide(state, policy, event)
+    if (event.outcome == "drained" and event.probe
+            and not event.complete_ckpt):
+        return dataclasses.replace(d, outcome="drained", rc_zero=True)
+    return d
+
+
+def audit_badfault(label: str = "badfault") -> FaultAuditReport:
+    """Seeded true-positive demo: must report exactly
+    {RKT1001, RKT1002, RKT1003}."""
+    report = FaultAuditReport(label)
+    with tempfile.TemporaryDirectory(prefix="rocket-badfault-") as tmpdir:
+        journal = _badfault_journal(os.path.join(tmpdir, "bad"))
+        verdicts = replay_crash_prefixes(
+            journal, os.path.join(tmpdir, "replay"), seed_dir=None)
+        report.findings.extend(
+            check_crash_prefixes(verdicts, label=label))
+        report.findings.extend(
+            check_atomic_commit(journal, label=label))
+    facts = model_check(decide_fn=_bad_decide)
+    report.findings.extend(check_invariants(
+        facts["violations"], label=label))
+    # drain_failed stays reachable through the crash-under-drain event,
+    # so the demo seeds NO RKT1004 — precision is part of the contract.
+    report.findings.extend(check_reachability(
+        facts["terminals"], TERMINAL_OUTCOMES, facts["livelocks"],
+        label=label))
+    return report
+
+
+# -- targets -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultTarget:
+    """One crash-consistency self-gate configuration the CLI audits."""
+
+    name: str
+    kind: str  # "ckpt" | "model" | "signals" | "demo"
+    demo: bool = False
+
+
+FAULT_TARGETS: dict[str, FaultTarget] = {
+    target.name: target
+    for target in (
+        FaultTarget("ckpt_protocol", "ckpt"),
+        FaultTarget("supervisor_model", "model"),
+        FaultTarget("signal_handlers", "signals"),
+        FaultTarget("badfault", "demo", demo=True),
+    )
+}
+
+
+def run_fault_target(target: FaultTarget) -> FaultAuditReport:
+    if target.kind == "ckpt":
+        return audit_checkpoint_protocol(label=target.name)
+    if target.kind == "model":
+        return audit_supervisor_model(label=target.name)
+    if target.kind == "signals":
+        return audit_signal_handlers(label=target.name)
+    if target.kind == "demo":
+        return audit_badfault(label=target.name)
+    raise ValueError(f"unknown fault target kind {target.kind!r}")

@@ -14,6 +14,9 @@ by :mod:`rocket_tpu.analysis.shard_audit`; precision rules by
 
 from __future__ import annotations
 
+from rocket_tpu.analysis.rules.artifact_rules import (
+    NonatomicArtifactWriteRule,
+)
 from rocket_tpu.analysis.rules.capsule_rules import (
     CapsuleSuperRule,
     HandlerSignatureRule,
@@ -34,6 +37,7 @@ from rocket_tpu.analysis.rules.jit_rules import (
     UndonatedJitStateRule,
 )
 from rocket_tpu.analysis.rules.calib_rules import CALIB_RULES
+from rocket_tpu.analysis.rules.fault_rules import FAULT_RULES
 from rocket_tpu.analysis.rules.mem_rules import MEM_RULES
 from rocket_tpu.analysis.rules.prec_rules import PREC_RULES
 from rocket_tpu.analysis.rules.race_rules import UnlockedMutationRule
@@ -45,7 +49,7 @@ from rocket_tpu.analysis.rules.spmd_rules import SPMD_RULES
 
 __all__ = ["AST_RULES", "AUDIT_RULES", "SPMD_RULES", "PREC_RULES",
            "SCHED_RULES", "SERVE_RULES", "CALIB_RULES", "MEM_RULES",
-           "REPRO_RULES", "all_rules"]
+           "REPRO_RULES", "FAULT_RULES", "all_rules"]
 
 #: AST rules, run by rocketlint in id order.
 AST_RULES = (
@@ -62,6 +66,7 @@ AST_RULES = (
     UnlockedMutationRule(),
     SwallowedInterruptRule(),
     UndonatedJitStateRule(),
+    NonatomicArtifactWriteRule(),
 )
 
 #: Jaxpr-audit rules (id, slug, contract) — implemented in trace_audit.py.
@@ -90,11 +95,12 @@ AUDIT_RULES = (
 def all_rules():
     """(id, slug, contract) for every rule — AST (RKT1xx), jaxpr audit
     (RKT2xx), SPMD audit (RKT3xx), precision audit (RKT4xx), schedule
-    audit (RKT5xx), serving audit (RKT6xx), calibration audit (RKT7xx)
-    and memory audit (RKT8xx) — in id order."""
+    audit (RKT5xx), serving audit (RKT6xx), calibration audit (RKT7xx),
+    memory audit (RKT8xx), determinism audit (RKT9xx) and fault audit
+    (RKT10xx) — in id order."""
     ast_meta = [(r.rule_id, r.slug, r.contract) for r in AST_RULES]
     return tuple(sorted(
         ast_meta + list(AUDIT_RULES) + list(SPMD_RULES) + list(PREC_RULES)
         + list(SCHED_RULES) + list(SERVE_RULES) + list(CALIB_RULES)
-        + list(MEM_RULES) + list(REPRO_RULES)
+        + list(MEM_RULES) + list(REPRO_RULES) + list(FAULT_RULES)
     ))

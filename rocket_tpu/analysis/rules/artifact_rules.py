@@ -1,0 +1,150 @@
+"""AST rule over JSON artifact durability (the RKT1002 lint cousin).
+
+Run-state artifacts — supervisor state, capture metadata, tokenizer
+vocabularies, audit reports — are read back after crashes; that is why
+they exist. A function that serializes one straight into its final
+path (``json.dump(obj, open(path, "w"))``) has a crash window in which
+the artifact is truncated or half-written: the next reader gets a
+``JSONDecodeError`` (or worse, a parseable prefix) exactly when the
+state mattered most. The committed idiom everywhere in this repo is
+write-to-temp + ``os.replace`` in the same function (ideally with an
+fsync of the temp — see RKT1002 / ``checkpoint_io.atomic_write``):
+readers then see either the old artifact or the new one, never the
+window.
+
+The rule's scope unit is the enclosing function: a write-mode
+``open`` handle that receives ``json.dump(obj, handle)`` or
+``handle.write(json.dumps(...))`` fires UNLESS the same function also
+calls ``os.replace``/``os.rename`` (the temp-then-rename shape) or
+delegates to an ``atomic_write``-style helper. Read-mode handles,
+non-JSON writes and log-like appends are out of scope — the rule
+targets the serialize-state-in-place shape, not all file I/O.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = ["NonatomicArtifactWriteRule"]
+
+#: Calls whose presence in the function marks it as the commit step of
+#: a temp-then-rename protocol (or a delegation to one).
+_COMMIT_CALLS = frozenset({
+    "os.replace", "os.rename", "atomic_write", "checkpoint_io.atomic_write",
+    "write_budget", "budgets.write_budget",
+})
+
+
+def _dotted(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """True when an ``open(...)`` call requests a write/append mode."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False
+    return any(c in mode.value for c in "wax") and "r" not in mode.value
+
+
+def _scope_of(node, parents):
+    cursor = parents.get(node)
+    while cursor is not None and not isinstance(
+        cursor, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        cursor = parents.get(cursor)
+    return cursor  # None = module scope
+
+
+class NonatomicArtifactWriteRule:
+    rule_id = "RKT114"
+    slug = "nonatomic-artifact-write"
+    contract = (
+        "a function serializes a JSON artifact straight into its final "
+        "path (json.dump into a write-mode handle, or handle.write("
+        "json.dumps(...))) with no os.replace/os.rename in the same "
+        "function — a crash mid-write leaves a truncated artifact where "
+        "readers expect the previous complete one; write to a temp file "
+        "and os.replace it over the destination"
+    )
+
+    def check(self, ctx) -> Iterable[Finding]:
+        # Pass 1: per-scope facts — write-mode handle names and whether
+        # the scope commits via rename (or delegates to a helper that
+        # does).
+        handles: dict = {}   # scope -> {name: open() lineno}
+        commits: set = set()  # scopes containing a commit call
+
+        def note_handle(scope, name, lineno):
+            handles.setdefault(id(scope), {}).setdefault(name, lineno)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            scope = _scope_of(node, ctx.parents)
+            if name in _COMMIT_CALLS:
+                commits.add(id(scope))
+                continue
+            if name not in ("open", "io.open") or not _write_mode(node):
+                continue
+            parent = ctx.parents.get(node)
+            if isinstance(parent, ast.withitem) and isinstance(
+                parent.optional_vars, ast.Name
+            ):
+                note_handle(scope, parent.optional_vars.id, node.lineno)
+            elif isinstance(parent, ast.Assign) and len(
+                parent.targets
+            ) == 1 and isinstance(parent.targets[0], ast.Name):
+                note_handle(scope, parent.targets[0].id, node.lineno)
+
+        # Pass 2: JSON serialization into one of those handles.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            scope = _scope_of(node, ctx.parents)
+            if id(scope) in commits:
+                continue
+            scope_handles = handles.get(id(scope), {})
+            if not scope_handles:
+                continue
+            name = _dotted(node.func)
+            hit = None
+            if name in ("json.dump",) and len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Name) and \
+                    node.args[1].id in scope_handles:
+                hit = f"json.dump(..., {node.args[1].id})"
+            elif name is not None and name.endswith(".write"):
+                receiver = name.rsplit(".", 1)[0]
+                if receiver in scope_handles and any(
+                    isinstance(inner, ast.Call)
+                    and _dotted(inner.func) == "json.dumps"
+                    for arg in node.args
+                    for inner in ast.walk(arg)
+                ):
+                    hit = f"{receiver}.write(json.dumps(...))"
+            if hit is None:
+                continue
+            where = "<module>" if scope is None else scope.name
+            yield Finding(
+                self.rule_id, ctx.path, node.lineno,
+                f"{hit} serializes an artifact into its final path with "
+                f"no os.replace/os.rename anywhere in {where!r} — a "
+                "crash mid-write leaves a truncated file where readers "
+                "expect the previous complete artifact; write to a temp "
+                "file in the same directory and os.replace it over the "
+                "destination",
+            )

@@ -1,0 +1,251 @@
+"""Crash-consistency / failure-path rules (RKT1001-1006) — check functions.
+
+The resilience layer's claims are all of the form "no interleaving of
+crashes and saves can lose committed work": ``is_complete_checkpoint``
+must reject every torn save prefix, resume must fall back to the last
+complete step, the supervisor's restart/degrade/crash-loop state
+machine must terminate and never certify a clean stop without a
+durable checkpoint, and the signal handlers that feed it must stay
+async-signal-safe. :mod:`rocket_tpu.analysis.fault_audit` extracts the
+facts — the journaled filesystem-effect sequence of each save path,
+the crash-prefix replay verdicts, the model checker's reachability
+facts, the installed-handler call graphs — and the pure check
+functions here turn them into findings, so the rules are unit-testable
+without touching a filesystem or running a supervisor.
+
+RKT1006 is the budget gate
+(:func:`rocket_tpu.analysis.budgets.diff_budget` with
+``FAULT_GATED_KEYS``): a shrinking crash-point or explored-state count
+means a save path or the transition function lost coverage — the audit
+got weaker without anyone deciding it should.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+from rocket_tpu.analysis.findings import Finding
+
+__all__ = [
+    "FAULT_RULES",
+    "check_crash_prefixes",
+    "check_atomic_commit",
+    "check_invariants",
+    "check_reachability",
+    "check_signal_handlers",
+]
+
+#: (id, slug, contract) for --list-rules and docs/analysis.md.
+FAULT_RULES = (
+    ("RKT1001", "torn-state-accepted",
+     "a crash prefix of a save path yields a directory that "
+     "is_complete_checkpoint ACCEPTS but whose content differs from the "
+     "completed save (or resume fails to fall back to the last complete "
+     "step, or the finished save is itself rejected)"),
+    ("RKT1002", "missing-atomic-commit",
+     "a save path commits an artifact by rename without fsyncing the "
+     "temp file first (a host crash after the rename can reveal an "
+     "empty committed file), or writes completeness-covered payload "
+     "AFTER the rng.json completeness marker"),
+    ("RKT1003", "supervisor-invariant-violation",
+     "an exhaustive outcome sequence drove the supervisor transition "
+     "function into an invariant violation: restart budget "
+     "non-monotonic, nproc below min_procs, rc-0 stop without "
+     "completed/drained, or drained-rc-0 without a complete checkpoint"),
+    ("RKT1004", "unreachable-or-absorbing-state",
+     "a terminal outcome of the supervision state machine is "
+     "unreachable under the event alphabet, or a reachable state "
+     "cannot terminate under a sustained crash flood (livelock)"),
+    ("RKT1005", "signal-handler-safety",
+     "an installed signal handler is not async-signal-safe: it logs, "
+     "prints, does I/O, or acquires a lock instead of staying "
+     "flag-set-only (a signal landing while the interrupted thread "
+     "holds the logging/lock internals deadlocks the process)"),
+    ("RKT1006", "fault-budget-regression",
+     "a gated fault-audit coverage metric regressed (>10% drop in "
+     "crash points enumerated, states explored, or handlers checked) "
+     "vs tests/fixtures/budgets/fault/"),
+)
+
+
+def _fault_path(label: str) -> str:
+    return f"<fault:{label}>"
+
+
+def check_crash_prefixes(
+    replays: Sequence[Mapping],
+    *,
+    label: str = "ckpt",
+) -> list[Finding]:
+    """RKT1001 over the crash-prefix replay verdicts.
+
+    Each replay entry describes one crash prefix ``k`` of a journaled
+    save path, materialized into a fresh directory:
+
+    - ``complete``: ``is_complete_checkpoint`` accepted the target
+      step directory at this prefix;
+    - ``consistent``: every completeness-covered file equals its bytes
+      in the finished save AND the pytree loads (only meaningful when
+      ``complete``);
+    - ``fallback_ok``: ``newest_complete_step`` resolved to the last
+      pre-existing complete step while the target was torn, and to the
+      target step once accepted;
+    - ``final``: this is the full (uncrashed) effect sequence.
+    """
+    out: list[Finding] = []
+    for r in replays:
+        k = r.get("k", -1)
+        where = _fault_path(f"{label}@prefix{k}")
+        if r.get("complete") and not r.get("consistent", True):
+            out.append(Finding(
+                "RKT1001", where, 0,
+                f"crash prefix {k} is ACCEPTED by is_complete_checkpoint "
+                "but its content differs from the completed save — a "
+                "resume from this state silently loads torn data",
+            ))
+        if not r.get("fallback_ok", True):
+            out.append(Finding(
+                "RKT1001", where, 0,
+                f"crash prefix {k}: newest_complete_step resolved to "
+                f"{r.get('fallback_step')!r} instead of the last durable "
+                "step — resume would not fall back to committed work",
+            ))
+        if r.get("final") and not r.get("complete"):
+            out.append(Finding(
+                "RKT1001", where, 0,
+                "the COMPLETED save sequence is rejected by "
+                "is_complete_checkpoint — the completeness predicate "
+                "lost sensitivity and every resume would discard it",
+            ))
+    return out
+
+
+def check_atomic_commit(
+    journal: Sequence[tuple],
+    *,
+    label: str = "ckpt",
+    exempt_suffixes: Sequence[str] = ("drain.json",),
+) -> list[Finding]:
+    """RKT1002 over one journaled filesystem-effect sequence.
+
+    ``journal`` is the ordered effect list a recording filesystem shim
+    captured from one save path: ``("makedirs", path)``,
+    ``("mktemp", path)``, ``("write", path)``, ``("fsync", path)``,
+    ``("replace", src, dst)`` (payload bytes, if journaled, are
+    ignored here). Two contracts:
+
+    - every rename of a written temp file must be preceded by an fsync
+      of that temp AFTER its last write — rename-without-fsync lets a
+      host crash commit an empty file;
+    - after the ``rng.json`` completeness-marker rename, no
+      completeness-covered payload may be written or committed (the
+      ``drain.json`` sidecar is the documented exemption) — the marker
+      must be the LAST durable effect the completeness predicate sees.
+    """
+    out: list[Finding] = []
+    where = _fault_path(label)
+    tmp_files: set = set()
+    synced_after_write: set = set()
+    marker_at: int | None = None
+    for i, effect in enumerate(journal):
+        op, args = effect[0], effect[1:]
+        if op == "mktemp":
+            tmp_files.add(args[0])
+            synced_after_write.discard(args[0])
+        elif op == "write":
+            synced_after_write.discard(args[0])
+            if marker_at is not None and args[0] not in tmp_files and not any(
+                args[0].endswith(s) for s in exempt_suffixes
+            ):
+                out.append(Finding(
+                    "RKT1002", where, 0,
+                    f"effect {i}: payload write of {args[0]!r} AFTER the "
+                    "rng.json completeness marker — a crash here leaves a "
+                    "directory the marker already certifies",
+                ))
+        elif op == "fsync":
+            synced_after_write.add(args[0])
+        elif op == "replace":
+            src, dst = args[0], args[1]
+            if src in tmp_files and src not in synced_after_write:
+                out.append(Finding(
+                    "RKT1002", where, 0,
+                    f"effect {i}: rename {src!r} -> {dst!r} without an "
+                    "fsync of the temp file after its last write — a host "
+                    "crash after the rename can reveal an empty "
+                    f"{dst!r}",
+                ))
+            if marker_at is not None and not any(
+                dst.endswith(s) for s in exempt_suffixes
+            ):
+                out.append(Finding(
+                    "RKT1002", where, 0,
+                    f"effect {i}: commit of {dst!r} AFTER the rng.json "
+                    "completeness marker — the marker must be the last "
+                    "completeness-covered effect",
+                ))
+            if dst.endswith("rng.json") and marker_at is None:
+                marker_at = i
+    return out
+
+
+def check_invariants(
+    violations: Iterable[str],
+    *,
+    label: str = "supervisor",
+) -> list[Finding]:
+    """RKT1003 over the model checker's per-transition assertions."""
+    return [
+        Finding("RKT1003", _fault_path(label), 0, message)
+        for message in violations
+    ]
+
+
+def check_reachability(
+    reached_terminals: Iterable[str],
+    expected_terminals: Iterable[str],
+    livelocks: Iterable[str] = (),
+    *,
+    label: str = "supervisor",
+) -> list[Finding]:
+    """RKT1004: every terminal outcome must be reachable, and every
+    reachable state must terminate under a sustained crash flood."""
+    out: list[Finding] = []
+    where = _fault_path(label)
+    reached = set(reached_terminals)
+    for terminal in sorted(set(expected_terminals) - reached):
+        out.append(Finding(
+            "RKT1004", where, 0,
+            f"terminal outcome {terminal!r} is unreachable under the "
+            "event alphabet — the state machine cannot express a "
+            "verdict the operator contract promises",
+        ))
+    for state in livelocks:
+        out.append(Finding(
+            "RKT1004", where, 0,
+            f"state {state} does not terminate under a sustained "
+            "no-progress crash flood — the supervisor could thrash "
+            "forever (absorbing non-terminal region)",
+        ))
+    return out
+
+
+def check_signal_handlers(
+    handler_violations: Sequence[tuple],
+) -> list[Finding]:
+    """RKT1005 over the handler-body call scan.
+
+    ``handler_violations`` holds ``(path, line, handler, call)`` for
+    every call inside an installed signal handler (one hop deep) that
+    is not on the async-signal-safe allowlist.
+    """
+    return [
+        Finding(
+            "RKT1005", path, line,
+            f"signal handler {handler!r} calls {call!r} — handlers must "
+            "be flag-set-only (no logging, no I/O, no lock "
+            "acquisition): a signal landing while the interrupted "
+            "thread holds that lock deadlocks the process",
+        )
+        for path, line, handler, call in handler_violations
+    ]

@@ -214,9 +214,14 @@ class BPETokenizer:
 
     def save(self, path: str) -> None:
         import json
+        import os
 
-        with open(path, "w") as f:
+        # Temp-then-rename (RKT114): a re-save interrupted mid-dump
+        # must not truncate the vocabulary a resuming run reads back.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"merges": [list(m) for m in self.merges]}, f)
+        os.replace(tmp, path)
 
     @classmethod
     def load(cls, path: str) -> "BPETokenizer":

@@ -203,13 +203,17 @@ class TraceSession:
         trace_file = find_trace_file(self.trace_dir)
         if trace_file is not None:
             try:
-                with open(os.path.join(self.trace_dir, CAPTURE_META_FILE),
-                          "w", encoding="utf-8") as f:
+                # Temp-then-rename (RKT114): a crash mid-dump must not
+                # leave a truncated sidecar next to a good trace.
+                meta = os.path.join(self.trace_dir, CAPTURE_META_FILE)
+                tmp = meta + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
                     json.dump({
                         "device_kind": jax.devices()[0].device_kind,
                         "platform": jax.default_backend(),
                         "n_devices": jax.device_count(),
                     }, f)
+                os.replace(tmp, meta)
             except Exception:  # noqa: BLE001 — metadata is best-effort
                 pass
         return trace_file

@@ -424,7 +424,13 @@ def install_signal_drain(drain: DrainState, logger=None) -> bool:
     with a KeyboardInterrupt while its supervisor is busy orchestrating
     the graceful drain the user asked for. The first Ctrl-C requests a
     drain and RESTORES the previous SIGINT disposition — a second Ctrl-C
-    interrupts hard, the terminal contract."""
+    interrupts hard, the terminal contract.
+
+    The handlers themselves are flag-set-only (async-signal-safe, the
+    RKT1005 contract): no logging — the logging module takes a lock,
+    and a signal landing while this thread holds it would deadlock.
+    The Looper logs the drain reason when it honors the request at the
+    next wave boundary, so no information is lost."""
     if threading.current_thread() is not threading.main_thread():
         if logger is not None:
             logger.warning(
@@ -436,10 +442,6 @@ def install_signal_drain(drain: DrainState, logger=None) -> bool:
 
         def handler(signum, frame):
             drain.request("SIGTERM")
-            if logger is not None:
-                logger.warning(
-                    "SIGTERM received — draining at the next wave boundary"
-                )
             if callable(previous) and previous not in (
                 signal.SIG_IGN, signal.SIG_DFL, signal.default_int_handler,
             ):
@@ -451,11 +453,6 @@ def install_signal_drain(drain: DrainState, logger=None) -> bool:
 
         def int_handler(signum, frame):
             drain.request("SIGINT")
-            if logger is not None:
-                logger.warning(
-                    "SIGINT received — draining at the next wave boundary "
-                    "(press again to interrupt hard)"
-                )
             signal.signal(signal.SIGINT, previous_int)
 
         signal.signal(signal.SIGINT, int_handler)

@@ -57,6 +57,10 @@ from rocket_tpu.resilience.faults import (
 __all__ = [
     "RestartPolicy",
     "GenerationRecord",
+    "GenEvent",
+    "LoopState",
+    "Decision",
+    "decide",
     "Supervisor",
     "SUPERVISOR_FILE",
     "is_complete_checkpoint",
@@ -190,7 +194,156 @@ def _classify(rc: int) -> str:
     return "crashed"
 
 
+# -- the pure transition function --------------------------------------------
+#
+# The restart/degrade/crash-loop control flow is a state machine over
+# generation outcomes, extracted here as a pure function so the live
+# loop (Supervisor.run) and the crash-consistency model checker
+# (rocket_tpu.analysis.fault_audit) execute ONE implementation: the
+# model check's exhaustive sequences exercise exactly the code that
+# decides restarts in production, not a re-derivation of it.
+
+
+@dataclasses.dataclass(frozen=True)
+class GenEvent:
+    """What one finished generation looked like from the outside."""
+
+    #: ``completed`` / ``drained`` / ``wedged`` / ``crashed`` (see
+    #: :func:`_classify`).
+    outcome: str
+    #: Durable progress observed (checkpoint advance, or the duration
+    #: heuristic when no probe is configured).
+    progressed: bool = False
+    #: Coordinator bind/connect failure — infrastructure noise.
+    coord_error: bool = False
+    #: A drain was requested (signal or API) before/while the
+    #: generation exited with a non-drained code.
+    drain_requested: bool = False
+    #: The checkpoint probe sees at least one complete checkpoint.
+    complete_ckpt: bool = False
+    #: A checkpoint probe (``ckpt_dir``) is configured at all.
+    probe: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopState:
+    """The supervision loop's entire mutable decision state."""
+
+    nproc: int
+    restarts: int = 0
+    consecutive_failures: int = 0
+    failures_at_nproc: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """What :func:`decide` resolved for one generation outcome."""
+
+    #: Successor state (the state to run the next generation under when
+    #: ``stop`` is false; the final counter values when it is true).
+    state: LoopState
+    #: Terminal verdict reached — the run ends now.
+    stop: bool
+    #: Terminal outcome name (``""`` while the loop continues).
+    outcome: str = ""
+    #: Terminal exit code is 0 (clean stop); otherwise the generation rc.
+    rc_zero: bool = False
+    #: This decision shrank the topology by one worker.
+    degraded: bool = False
+    #: Failure count feeding the backoff for the next generation.
+    backoff_failures: int = 0
+
+
+def decide(state: LoopState, policy: RestartPolicy,
+           event: GenEvent) -> Decision:
+    """One supervision step: generation outcome -> restart / stop.
+
+    Order matters and is load-bearing: drained-without-checkpoint is
+    refused before anything else, a pending drain turns any crash into
+    ``drain_failed``, the restart budget is checked before degrade,
+    degrade (which resets BOTH failure counters — the re-resolution is
+    itself the recovery action) before the crash-loop verdict."""
+    if event.outcome == "completed":
+        return Decision(state=state, stop=True, outcome="completed",
+                        rc_zero=True)
+    if event.outcome == "drained":
+        if event.probe and not event.complete_ckpt:
+            # Workers exited the drained code but the probe sees NO
+            # durable checkpoint to resume from — rc 0 would tell an
+            # orchestrator state was saved.
+            return Decision(state=state, stop=True, outcome="drain_failed")
+        return Decision(state=state, stop=True, outcome="drained",
+                        rc_zero=True)
+    if event.drain_requested:
+        # Workers died (or were force-killed after the drain grace)
+        # instead of draining — honored, but not a certified clean stop.
+        return Decision(state=state, stop=True, outcome="drain_failed")
+
+    # A crashed/wedged generation: decide whether to restart.
+    nproc = state.nproc
+    cf = state.consecutive_failures
+    fa = state.failures_at_nproc
+    if event.progressed:
+        cf = 0
+        fa = 0
+    elif not event.coord_error:
+        cf += 1
+        fa += 1
+
+    if state.restarts >= policy.max_restarts:
+        return Decision(
+            state=dataclasses.replace(
+                state, consecutive_failures=cf, failures_at_nproc=fa),
+            stop=True, outcome="restart_budget_exhausted")
+    degraded = False
+    if fa >= policy.degrade_after and nproc > policy.min_procs:
+        nproc -= 1
+        fa = 0
+        cf = 0
+        degraded = True
+    if cf >= policy.crash_loop_threshold:
+        return Decision(
+            state=LoopState(nproc, state.restarts, cf, fa),
+            stop=True, outcome="crash_loop", degraded=degraded)
+    return Decision(
+        state=LoopState(nproc, state.restarts + 1, cf, fa),
+        stop=False, degraded=degraded, backoff_failures=cf)
+
+
 # -- the supervisor ----------------------------------------------------------
+
+
+class _DrainFlag:
+    """Async-signal-safe drain latch with the ``threading.Event`` API
+    surface the generation runners and tests rely on.
+
+    ``set``/``is_set``/``clear`` are plain attribute operations — safe
+    inside a signal handler, unlike ``threading.Event.set`` which
+    acquires a ``Condition`` lock and can deadlock if the signal lands
+    while the main thread holds it (the RKT1005 contract). ``wait``
+    polls at 20 ms granularity, which is ample for backoff sleeps."""
+
+    __slots__ = ("_set",)
+
+    def __init__(self) -> None:
+        self._set = False
+
+    def set(self) -> None:
+        self._set = True
+
+    def clear(self) -> None:
+        self._set = False
+
+    def is_set(self) -> bool:
+        return self._set
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._set:
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        return self._set
 
 
 class Supervisor:
@@ -256,7 +409,8 @@ class Supervisor:
         self.extra_env = dict(extra_env or {})
         self._run_generation = run_generation or self._run_generation_default
         self._clock = clock
-        self._drain_event = threading.Event()
+        self._drain_event = _DrainFlag()
+        self._pending_drain_reason: Optional[str] = None
         # Drain-interruptible sleep by default: a SIGTERM during backoff
         # must stop the run now, not after the backoff expires.
         self._sleep = sleep or (lambda s: self._drain_event.wait(s))
@@ -276,13 +430,35 @@ class Supervisor:
 
     # -- signals -----------------------------------------------------------
 
-    def request_drain(self, reason: str = "signal") -> None:
+    def _note_drain(self, reason: str = "signal") -> None:
+        """Async-signal-safe drain notation: attribute writes and a
+        plain-bool flag set, nothing else — no logging, no allocation
+        the interpreter doesn't already do for the call itself, no lock
+        acquisition (RKT1005). The log line is deferred to
+        :meth:`_flush_drain_log`, which the run loop calls at its next
+        observation point."""
         self.drain_signals += 1
+        self._pending_drain_reason = reason
         self._drain_event.set()
-        self._log(f"drain requested ({reason}) — forwarding to workers")
+
+    def _flush_drain_log(self) -> None:
+        reason, self._pending_drain_reason = self._pending_drain_reason, None
+        if reason is not None:
+            self._log(f"drain requested ({reason}) — forwarding to workers")
+
+    def request_drain(self, reason: str = "signal") -> None:
+        """Programmatic drain request (NOT for signal handlers — those
+        go through :meth:`_note_drain` so the handler stays
+        async-signal-safe)."""
+        self._note_drain(reason)
+        self._flush_drain_log()
 
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT -> drain (main thread only; the CLI path).
+
+        The handlers are flag-set-only (:meth:`_note_drain`): no
+        logging, no locks — a signal landing while the main thread
+        holds the logging-module lock must not deadlock the supervisor.
 
         The first Ctrl-C requests the drain and restores the previous
         SIGINT disposition, so a second Ctrl-C interrupts hard instead
@@ -294,12 +470,12 @@ class Supervisor:
             return
 
         def term_handler(signum, frame):
-            self.request_drain(signal.Signals(signum).name)
+            self._note_drain(signal.Signals(signum).name)
 
         previous_int = signal.getsignal(signal.SIGINT)
 
         def int_handler(signum, frame):
-            self.request_drain("SIGINT")
+            self._note_drain("SIGINT")
             signal.signal(signal.SIGINT, previous_int)
 
         signal.signal(signal.SIGTERM, term_handler)
@@ -350,28 +526,27 @@ class Supervisor:
 
     def run(self) -> int:
         policy = self.policy
-        nproc = self.nproc
-        consecutive_failures = 0
-        failures_at_nproc = 0
+        state = LoopState(nproc=self.nproc)
         gen = 0
 
         while True:
             record = GenerationRecord(
-                gen=gen, nproc=nproc, started_unix=time.time()
+                gen=gen, nproc=state.nproc, started_unix=time.time()
             )
             self.generations.append(record)
             start = self._clock()
             step_before = self._last_ckpt_step
             self._log(
-                f"generation {gen}: launching {nproc} worker(s) "
+                f"generation {gen}: launching {state.nproc} worker(s) "
                 f"(restarts so far: {self.restarts})"
             )
             result = self._run_generation(
-                gen, nproc, self._drain_event, self._observe_progress
+                gen, state.nproc, self._drain_event, self._observe_progress
             )
             rc, codes, tail = result[:3]
             coord_error = len(result) > 3 and bool(result[3])
             self._observe_progress(force=True)  # catch a final-save advance
+            self._flush_drain_log()
             end = self._clock()
 
             record.duration_s = end - start
@@ -402,31 +577,29 @@ class Supervisor:
             if record.outcome not in ("completed", "drained"):
                 record.output_tail = tail or None
 
-            if record.outcome == "completed":
-                return self._finish("completed", 0)
-            if record.outcome == "drained":
-                if self.ckpt_dir is not None and self._last_ckpt_step is None:
-                    # Workers exited the drained code but the probe sees
-                    # NO durable checkpoint to resume from (a
-                    # checkpointer-less script, or every save torn) —
-                    # rc 0 would tell an orchestrator state was saved.
-                    self._log(
-                        "workers drained but no complete checkpoint "
-                        f"exists under {self.ckpt_dir!r} — not a "
-                        "certified clean stop"
-                    )
-                    return self._finish("drain_failed", rc or 1)
-                return self._finish("drained", 0)
-            if self._drain_event.is_set():
-                # Workers died (or were force-killed after the drain
-                # grace) instead of draining — not a clean stop.
-                return self._finish("drain_failed", rc or 1)
+            event = GenEvent(
+                outcome=record.outcome,
+                progressed=record.progressed,
+                coord_error=coord_error,
+                drain_requested=self._drain_event.is_set(),
+                complete_ckpt=self._last_ckpt_step is not None,
+                probe=self.ckpt_dir is not None,
+            )
+            decision = decide(state, policy, event)
 
-            # A crashed/wedged generation: decide whether to restart.
-            if record.progressed:
-                consecutive_failures = 0
-                failures_at_nproc = 0
-            elif coord_error:
+            # Narrate the decision (the pure function stays log-free).
+            crash_branch = (
+                event.outcome in ("crashed", "wedged")
+                and not event.drain_requested
+            )
+            if decision.outcome == "drain_failed" and \
+                    record.outcome == "drained":
+                self._log(
+                    "workers drained but no complete checkpoint "
+                    f"exists under {self.ckpt_dir!r} — not a "
+                    "certified clean stop"
+                )
+            if crash_branch and event.coord_error and not event.progressed:
                 # Coordinator bind/connect failure at startup (a pinned
                 # --coordinator-port still in TIME_WAIT after the reap) —
                 # infrastructure noise, not the workload: retry on backoff
@@ -436,49 +609,40 @@ class Supervisor:
                     "coordinator startup failure — not counted against "
                     "the crash-loop/degrade thresholds"
                 )
-            else:
-                consecutive_failures += 1
-                failures_at_nproc += 1
-
-            if self.restarts >= policy.max_restarts:
+            if decision.outcome == "restart_budget_exhausted":
                 self._log(
                     f"restart budget exhausted ({policy.max_restarts}) — "
                     "giving up"
                 )
-                return self._finish("restart_budget_exhausted", rc or 1)
-            if (
-                failures_at_nproc >= policy.degrade_after
-                and nproc > policy.min_procs
-            ):
+            if decision.degraded:
                 # Re-resolve the surviving topology: the same count keeps
                 # dying before making progress, so assume a worker's slot
                 # is gone and restart smaller; the resharding restore
-                # handles the process-count change. Evaluated BEFORE the
-                # crash-loop verdict, and the re-resolution resets the
-                # failure streak — degradation is itself the recovery
-                # action, so each topology down to min_procs gets its own
-                # crash-loop budget (only the floor can declare a loop).
-                nproc -= 1
-                failures_at_nproc = 0
-                consecutive_failures = 0
+                # handles the process-count change (see decide()).
                 self._log(
-                    f"degrading to {nproc} worker(s) after repeated "
-                    "no-progress failures (elastic restart)"
+                    f"degrading to {decision.state.nproc} worker(s) after "
+                    "repeated no-progress failures (elastic restart)"
                 )
-            if consecutive_failures >= policy.crash_loop_threshold:
+            if decision.outcome == "crash_loop":
                 self._log(
-                    f"crash loop: {consecutive_failures} consecutive "
-                    "generations without progress — refusing to thrash"
+                    f"crash loop: {decision.state.consecutive_failures} "
+                    "consecutive generations without progress — refusing "
+                    "to thrash"
                 )
-                return self._finish("crash_loop", rc or 1)
 
-            record.backoff_s = policy.backoff_s(consecutive_failures)
+            if decision.stop:
+                return self._finish(
+                    decision.outcome, 0 if decision.rc_zero else (rc or 1)
+                )
+
+            record.backoff_s = policy.backoff_s(decision.backoff_failures)
             self._write_state()
             self._log(
                 f"generation {gen} {record.outcome} (rc={rc}); restarting "
                 f"in {record.backoff_s:.2f}s"
             )
             self._sleep(record.backoff_s)
+            self._flush_drain_log()
             if self._drain_event.is_set():
                 # The drain request interrupted the backoff: the run ends
                 # on a CRASHED generation with no drain checkpoint, so the
@@ -486,7 +650,8 @@ class Supervisor:
                 # as workers dying mid-drain. Exit 0 / "drained" is
                 # reserved for a generation that actually drained.
                 return self._finish("drain_failed", rc or 1)
-            self.restarts += 1
+            state = decision.state
+            self.restarts = state.restarts
             gen += 1
 
     # -- bookkeeping -------------------------------------------------------
@@ -527,6 +692,11 @@ class Supervisor:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(self.summary(), f, indent=1, sort_keys=True)
                 f.write("\n")
+                # fsync before the rename: a host crash mid-generation
+                # must not commit a truncated record that poisons the
+                # next goodput computation.
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except OSError as exc:  # state file is evidence, not control flow
             self._log(f"supervisor: could not write {SUPERVISOR_FILE}: {exc!r}")

@@ -35,6 +35,7 @@ Write protocol (multihost-safe, caller barriers between phases):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -50,6 +51,8 @@ from rocket_tpu.utils.pytree import key_path_str as _path_str
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "HostFS",
+    "use_fs",
     "atomic_write",
     "snapshot",
     "write_snapshot",
@@ -61,15 +64,75 @@ __all__ = [
 _INDEX = "index.json"
 
 
-def atomic_write(path: str, data: bytes) -> None:
-    """Write via a temp file + rename so a crash never leaves a torn file."""
-    directory = os.path.dirname(path) or "."
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
+# -- the filesystem-effects seam ---------------------------------------------
+
+
+class HostFS:
+    """The real filesystem behind the checkpoint write paths.
+
+    Every durable effect the save protocol performs goes through one of
+    these five methods, so the crash-consistency auditor
+    (:mod:`rocket_tpu.analysis.fault_audit`) can interpose a recording
+    shim via :func:`use_fs`, journal the exact effect sequence, and
+    replay every crash prefix. The vocabulary is deliberately the
+    POSIX durability alphabet: ``makedirs`` / ``mktemp`` / ``write`` /
+    ``fsync`` / ``replace`` — an atomic commit is write(tmp) →
+    fsync(tmp) → replace(tmp, final), in that order.
+    """
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def mktemp(self, directory: str, suffix: str = ".tmp") -> str:
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=suffix)
+        os.close(fd)
+        return tmp
+
+    def write(self, path: str, data: bytes) -> None:
+        with open(path, "wb") as f:
             f.write(data)
-        os.replace(tmp, path)
+
+    def fsync(self, path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def replace(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+
+_FS: HostFS = HostFS()
+
+
+@contextlib.contextmanager
+def use_fs(fs):
+    """Swap the module-level filesystem for the duration of the block —
+    the fault auditor's interposition point. Not reentrant; callers own
+    serializing concurrent writers (the auditor drains the
+    :class:`AsyncWriter` inside the block)."""
+    global _FS
+    previous, _FS = _FS, fs
+    try:
+        yield fs
+    finally:
+        _FS = previous
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write via temp file + fsync + rename so a crash never leaves a
+    torn file — and a host crash right after the rename never reveals an
+    empty committed file (the fsync orders the data before the commit;
+    rename-without-fsync is exactly what RKT1002 audits for)."""
+    fs = _FS
+    directory = os.path.dirname(path) or "."
+    fs.makedirs(directory)
+    tmp = fs.mktemp(directory)
+    try:
+        fs.write(tmp, data)
+        fs.fsync(tmp)
+        fs.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -185,7 +248,7 @@ def write_snapshot(path: str, plan: dict) -> None:
     index. ``index.json`` presence marks a complete main-process write;
     readers validate shard files against it.
     """
-    os.makedirs(path, exist_ok=True)
+    _FS.makedirs(path)
     buf = _NpzBytes(plan["local"])
     atomic_write(os.path.join(path, _shard_file(plan["process"])), buf.getvalue())
     if plan["process"] == 0:

@@ -2,11 +2,12 @@
 # One-shot CI gate: style lint (ruff) + tune table gate (checked-in
 # kernel-config legality + stale structural winners) + structural
 # kernel-search smoke + the `analysis all` umbrella (rocketlint +
-# every audit family — shard/prec/sched/serve/calib/mem/repro — one
-# process, one merged findings list, budgets diffed per family) +
+# every audit family — shard/prec/sched/serve/calib/mem/repro/fault —
+# one process, one merged findings list, budgets diffed per family) +
 # seeded-bad true-positive legs (badoverlap, drifted calib, badmem,
-# badrepro) + obs telemetry smoke + resilience smoke (supervised
-# restart / drain) + the tier-1 test suite (command from ROADMAP.md).
+# badrepro, badfault) + obs telemetry smoke + resilience smoke
+# (supervised restart / drain) + the tier-1 test suite (command from
+# ROADMAP.md).
 # Exits non-zero on the first failing stage.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -37,8 +38,8 @@ echo "== structural kernel search smoke (enumerate -> verify -> table round-trip
 JAX_PLATFORMS=cpu python scripts/tune_structural_smoke.py
 
 echo "== analysis all (rocketlint + every audit family, one invocation) =="
-# Replaces the seven per-family invocations: rocketlint over
-# rocket_tpu/ plus shard/prec/sched/serve/calib/mem/repro, each family
+# Replaces the per-family invocations: rocketlint over rocket_tpu/
+# plus shard/prec/sched/serve/calib/mem/repro/fault, each family
 # diffed against its canonical subdirectory of tests/fixtures/budgets/
 # (>10% growth fails; calib uses tolerance 0.5 because its measured
 # side is a live timing on a CPU container; repro fingerprints gate on
@@ -107,6 +108,23 @@ python - <<'PY' || { echo "badrepro demo rule set drifted:"; cat /tmp/_badrepro.
 import json
 rules = {f["rule"] for f in json.load(open("/tmp/_badrepro.json"))}
 assert rules == {"RKT901", "RKT902"}, rules
+PY
+
+echo "== fault true-positive (seeded-bad badfault demo) =="
+# The crash-consistency rules must still FIND what they were built to
+# kill: the marker-first / unsynced-rename save order plus the
+# drained-without-checkpoint transition function must report exactly
+# RKT1001 + RKT1002 + RKT1003 — no more (RKT1004 precision: the demo
+# keeps every terminal reachable) and no less.
+if JAX_PLATFORMS=cpu python -m rocket_tpu.analysis fault \
+        --target badfault --format json >/tmp/_badfault.json 2>&1; then
+    echo "badfault demo reported no findings - rules are broken"
+    exit 1
+fi
+python - <<'PY' || { echo "badfault demo rule set drifted:"; cat /tmp/_badfault.json; exit 1; }
+import json
+rules = {f["rule"] for f in json.load(open("/tmp/_badfault.json"))}
+assert rules == {"RKT1001", "RKT1002", "RKT1003"}, rules
 PY
 
 echo "== obs smoke (telemetry + health sentinels + strict step path) =="

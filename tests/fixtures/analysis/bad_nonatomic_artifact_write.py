@@ -1,0 +1,14 @@
+"""Fixture: RKT114 must fire — JSON artifacts serialized in place."""
+
+import json
+
+
+def save_state(state, path):
+    with open(path, "w") as f:
+        json.dump(state, f)  # no os.replace anywhere in this function
+
+
+def save_report(report, path):
+    handle = open(path, "w", encoding="utf-8")
+    handle.write(json.dumps(report, indent=2))
+    handle.close()

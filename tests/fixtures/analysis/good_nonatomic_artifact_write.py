@@ -1,0 +1,24 @@
+"""Fixture: RKT114 must stay quiet — temp-then-rename commits, reads,
+and non-JSON writes."""
+
+import json
+import os
+
+
+def save_state(state, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_state(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def append_log_line(path, line):
+    with open(path, "a") as f:
+        f.write(line + "\n")

@@ -49,6 +49,7 @@ AST_CASES = [
     ("RKT111", "undonated_jit_state"),
     ("RKT112", "unordered_iteration"),
     ("RKT113", "ambient_entropy"),
+    ("RKT114", "nonatomic_artifact_write"),
 ]
 
 

@@ -5,7 +5,8 @@
 (the roofline/schedule auditor), ``... serve`` (the serving-path
 auditor), ``... calib`` (measured-vs-predicted calibration) and
 ``... mem`` (the HBM liveness auditor), ``... repro`` (the determinism
-auditor) and the ``... all`` umbrella must hold the same machine
+auditor), ``... fault`` (the crash-consistency auditor) and the
+``... all`` umbrella must hold the same machine
 contract CI scripts depend on: exit
 0 on a clean tree, 1 on findings, 2 on usage errors, and one
 ``--format json`` output shape. The audit
@@ -61,14 +62,15 @@ def test_lint_exit_two_on_usage_errors():
     assert run_cli("does/not/exist.py").returncode == 2   # bad path
 
 
-def test_list_rules_includes_all_nine_families():
+def test_list_rules_includes_all_ten_families():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
     for rule_id in ("RKT101", "RKT108", "RKT109", "RKT111", "RKT112",
-                    "RKT113", "RKT201",
+                    "RKT113", "RKT114", "RKT201",
                     "RKT301", "RKT306", "RKT401", "RKT406", "RKT501",
                     "RKT506", "RKT601", "RKT606", "RKT701", "RKT703",
-                    "RKT801", "RKT805", "RKT901", "RKT906"):
+                    "RKT801", "RKT805", "RKT901", "RKT906",
+                    "RKT1001", "RKT1006"):
         assert rule_id in proc.stdout
 
 
@@ -80,11 +82,11 @@ def test_audit_registry_covers_every_subcommand():
     from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
 
     assert set(AUDIT_SUBCOMMANDS) == {"shard", "prec", "sched", "serve",
-                                      "calib", "mem", "repro"}
+                                      "calib", "mem", "repro", "fault"}
 
 
 @pytest.mark.parametrize("sub", ["shard", "prec", "sched", "serve",
-                                 "calib", "mem", "repro"])
+                                 "calib", "mem", "repro", "fault"])
 def test_every_audit_subcommand_holds_the_usage_contract(sub):
     assert run_cli(sub, "--target", "nope").returncode == 2
     assert run_cli(sub, "--update-budgets").returncode == 2  # no --budgets
@@ -109,6 +111,7 @@ DEMO_EXPECTED = {
                             "RKT605"},
     ("mem", "badmem"): {"RKT801", "RKT802", "RKT804"},
     ("repro", "badrepro"): {"RKT901", "RKT902"},
+    ("fault", "badfault"): {"RKT1001", "RKT1002", "RKT1003"},
 }
 
 
@@ -555,7 +558,7 @@ def test_all_lints_given_paths_with_merged_findings():
     """The umbrella's lint leg (bad fixture, no budgets): findings from
     rocketlint surface through the same JSON shape and exit 1. Slow:
     `all` always sweeps every audit family too, so even the lint-leg
-    assertion costs a full seven-family compile pass — scripts/check.sh
+    assertion costs a full eight-family sweep — scripts/check.sh
     exercises the umbrella on every CI run regardless."""
     proc = run_cli("all", os.path.join(FIXTURES, "bad_tracer_leak.py"),
                    "--format", "json", timeout=1200)
@@ -567,7 +570,7 @@ def test_all_lints_given_paths_with_merged_findings():
 
 @pytest.mark.slow
 def test_all_self_gate_is_clean_with_budgets_and_report(tmp_path):
-    """One invocation instead of seven: rocketlint + every audit family
+    """One invocation instead of eight: rocketlint + every audit family
     against the committed budgets — exit 0, and the --json-report
     artifact is written (an empty list when clean)."""
     report = tmp_path / "report.json"

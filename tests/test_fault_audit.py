@@ -1,0 +1,388 @@
+"""Crash-consistency & failure-path auditor (ISSUE 18, RKT10xx).
+
+Covers the three audit legs (crash-prefix replay, supervisor model
+check + conformance, signal-handler scan), the pure rule functions on
+synthetic facts, the pure ``decide`` transition function directly, the
+badfault seeded-bad demo's exact rule set, and the multi-host
+skewed-drain torn-layout story (ranks draining at different steps must
+fail ``is_complete_checkpoint`` and resume must fall back to the last
+complete periodic save).
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+
+from rocket_tpu.analysis.fault_audit import (
+    EVENT_ALPHABET,
+    FAULT_TARGETS,
+    TERMINAL_OUTCOMES,
+    RecordingFS,
+    _bad_decide,
+    _badfault_journal,
+    audit_checkpoint_protocol,
+    audit_signal_handlers,
+    conformance_check,
+    model_check,
+    replay_crash_prefixes,
+    run_fault_target,
+    scan_signal_handlers,
+)
+from rocket_tpu.analysis.rules.fault_rules import (
+    FAULT_RULES,
+    check_atomic_commit,
+    check_crash_prefixes,
+)
+from rocket_tpu.resilience.supervisor import (
+    GenEvent,
+    LoopState,
+    RestartPolicy,
+    decide,
+    is_complete_checkpoint,
+    newest_complete_step,
+)
+from rocket_tpu.runtime import checkpoint_io
+
+
+def rules_in(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- atomic_write effect ordering -------------------------------------------
+
+
+def test_atomic_write_orders_write_fsync_replace(tmp_path):
+    rec = RecordingFS(str(tmp_path))
+    dest = str(tmp_path / "state.json")
+    with checkpoint_io.use_fs(rec):
+        checkpoint_io.atomic_write(dest, b'{"ok": 1}')
+    ops = [e[0] for e in rec.journal]
+    assert ops == ["makedirs", "mktemp", "write", "fsync", "replace"]
+    # the fsync targets the temp file the rename then commits
+    assert rec.journal[3][1] == rec.journal[2][1] == rec.journal[4][1]
+    assert rec.journal[4][2] == "state.json"
+    with open(dest, "rb") as f:
+        assert f.read() == b'{"ok": 1}'
+    # the shim performed the real effects too, and check_atomic_commit
+    # has nothing to say about a correct sequence
+    assert check_atomic_commit(rec.journal) == []
+
+
+# -- crash-prefix enumeration over the real save paths ----------------------
+
+
+def test_checkpoint_protocol_audit_clean_with_total_coverage():
+    report = audit_checkpoint_protocol()
+    assert report.clean, [f.render() for f in report.findings]
+    record = report.record
+    # coverage is counted, not assumed: one prefix per journaled effect
+    # plus the empty prefix, for each of the three save paths
+    expected = sum(
+        record[f"effects_{name}"] + 1
+        for name in ("save", "save_drain", "save_emergency")
+    )
+    assert record["crash_points"] == expected
+    assert record["effects_save"] > 0
+    assert record["effects_save_drain"] > 0
+    assert record["effects_save_emergency"] > 0
+    assert "coverage_fingerprint" in record
+
+
+def test_marker_first_journal_yields_accepted_torn_state(tmp_path):
+    journal = _badfault_journal(str(tmp_path / "bad"))
+    verdicts = replay_crash_prefixes(
+        journal, str(tmp_path / "replay"), seed_dir=None)
+    assert len(verdicts) == len(journal) + 1
+    torn = [v for v in verdicts
+            if v["complete"] and not v["consistent"] and not v["final"]]
+    assert torn, verdicts  # the marker-first order IS the disease
+    assert "RKT1001" in rules_in(check_crash_prefixes(verdicts))
+    assert "RKT1002" in rules_in(check_atomic_commit(journal))
+
+
+# -- the journal rules on synthetic effect sequences ------------------------
+
+
+def test_rename_without_fsync_fires_rkt1002():
+    journal = [
+        ("mktemp", "2/.wip1.tmp"),
+        ("write", "2/.wip1.tmp"),
+        ("replace", "2/.wip1.tmp", "2/index.json"),
+    ]
+    findings = check_atomic_commit(journal)
+    assert rules_in(findings) == ["RKT1002"]
+    assert "fsync" in findings[0].message
+
+
+def test_write_after_marker_fires_except_drain_sidecar():
+    base = [
+        ("mktemp", "2/.wip1.tmp"),
+        ("write", "2/.wip1.tmp"),
+        ("fsync", "2/.wip1.tmp"),
+        ("replace", "2/.wip1.tmp", "2/rng.json"),
+    ]
+    assert check_atomic_commit(base) == []
+    bad = base + [("write", "2/model_0/index.json")]
+    assert rules_in(check_atomic_commit(bad)) == ["RKT1002"]
+    # the drain.json sidecar is the documented post-marker exemption,
+    # both as a plain write and as a temp-file commit
+    sidecar = base + [
+        ("mktemp", "2/.wip2.tmp"),
+        ("write", "2/.wip2.tmp"),
+        ("fsync", "2/.wip2.tmp"),
+        ("replace", "2/.wip2.tmp", "2/drain.json"),
+    ]
+    assert check_atomic_commit(sidecar) == []
+
+
+def test_check_crash_prefixes_on_synthetic_verdicts():
+    clean = [
+        {"k": 0, "complete": False, "consistent": True,
+         "fallback_ok": True, "fallback_step": 1, "final": False},
+        {"k": 1, "complete": True, "consistent": True,
+         "fallback_ok": True, "fallback_step": 2, "final": True},
+    ]
+    assert check_crash_prefixes(clean) == []
+    torn = [{"k": 3, "complete": True, "consistent": False,
+             "fallback_ok": True, "fallback_step": 2, "final": False}]
+    assert rules_in(check_crash_prefixes(torn)) == ["RKT1001"]
+    lost = [{"k": 2, "complete": False, "consistent": True,
+             "fallback_ok": False, "fallback_step": None, "final": False}]
+    assert rules_in(check_crash_prefixes(lost)) == ["RKT1001"]
+    rejected_final = [{"k": 9, "complete": False, "consistent": True,
+                       "fallback_ok": True, "fallback_step": 1,
+                       "final": True}]
+    assert rules_in(check_crash_prefixes(rejected_final)) == ["RKT1001"]
+
+
+# -- the pure transition function -------------------------------------------
+
+
+CRASH = GenEvent("crashed")
+
+
+def test_decide_degrades_to_floor_then_crash_loops():
+    policy = RestartPolicy(max_restarts=16, crash_loop_threshold=3,
+                           degrade_after=2, min_procs=1)
+    state = LoopState(nproc=3)
+    nprocs = []
+    outcome = None
+    for _ in range(12):
+        d = decide(state, policy, CRASH)
+        nprocs.append(d.state.nproc)
+        if d.stop:
+            outcome = d.outcome
+            break
+        state = d.state
+    # 3 -> degrade at the 2nd failure -> 2 -> degrade -> 1 (the floor),
+    # then the crash-loop detector is the only way out
+    assert nprocs == [3, 2, 2, 1, 1, 1, 1]
+    assert outcome == "crash_loop"
+    assert min(nprocs) >= policy.min_procs
+
+
+def test_decide_drained_certification_requires_checkpoint():
+    state = LoopState(nproc=2)
+    policy = RestartPolicy()
+    no_ckpt = decide(state, policy,
+                     GenEvent("drained", complete_ckpt=False, probe=True))
+    assert no_ckpt.stop and no_ckpt.outcome == "drain_failed"
+    assert not no_ckpt.rc_zero
+    with_ckpt = decide(state, policy,
+                       GenEvent("drained", complete_ckpt=True, probe=True))
+    assert with_ckpt.outcome == "drained" and with_ckpt.rc_zero
+    # without a probe there is nothing to check against
+    no_probe = decide(state, policy, GenEvent("drained", probe=False))
+    assert no_probe.outcome == "drained" and no_probe.rc_zero
+
+
+def test_decide_coord_error_counts_toward_neither_counter():
+    policy = RestartPolicy()
+    state = LoopState(nproc=2, consecutive_failures=1, failures_at_nproc=1)
+    d = decide(state, policy, GenEvent("crashed", coord_error=True))
+    assert not d.stop
+    assert d.state.consecutive_failures == 1
+    assert d.state.failures_at_nproc == 1
+
+
+def test_decide_restart_budget_is_a_hard_ceiling():
+    policy = RestartPolicy(max_restarts=2, crash_loop_threshold=99,
+                           degrade_after=99)
+    state = LoopState(nproc=2)
+    for expected_restarts in (1, 2):
+        d = decide(state, policy, CRASH)
+        assert not d.stop
+        assert d.state.restarts == expected_restarts
+        state = d.state
+    d = decide(state, policy, CRASH)
+    assert d.stop and d.outcome == "restart_budget_exhausted"
+
+
+# -- model check + conformance ----------------------------------------------
+
+
+def test_model_check_clean_and_reaches_every_terminal():
+    facts = model_check()
+    assert facts["violations"] == []
+    assert facts["livelocks"] == []
+    assert set(facts["terminals"]) == set(TERMINAL_OUTCOMES)
+    assert facts["states_explored"] > 0
+    assert facts["transitions_checked"] == (
+        facts["states_explored"] * len(EVENT_ALPHABET)
+    )
+    assert facts["sequences_at_depth"] == len(EVENT_ALPHABET) ** 6
+
+
+def test_model_check_catches_drained_without_checkpoint():
+    facts = model_check(decide_fn=_bad_decide)
+    assert any("drained" in v for v in facts["violations"])
+
+
+def test_conformance_live_loop_matches_transition_function(tmp_path):
+    result = conformance_check(str(tmp_path))
+    assert result["violations"] == [], result["violations"]
+    assert result["runs"] == 4 + 16 + 64  # every rc sequence, len 1..3
+
+
+# -- signal-handler scan -----------------------------------------------------
+
+
+def test_repo_signal_handlers_are_flag_set_only():
+    report = audit_signal_handlers()
+    assert report.clean, [f.render() for f in report.findings]
+    assert report.record["handlers_checked"] >= 2  # SIGTERM + SIGINT
+
+
+def test_signal_scan_fires_on_logging_handler(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad_handlers.py").write_text(textwrap.dedent("""
+        import logging
+        import signal
+
+        logger = logging.getLogger(__name__)
+
+
+        def handler(signum, frame):
+            logger.warning("caught %s", signum)
+            print("shutting down")
+
+
+        def install():
+            signal.signal(signal.SIGTERM, handler)
+    """))
+    (pkg / "good_handlers.py").write_text(textwrap.dedent("""
+        import signal
+
+
+        class Drain:
+            def __init__(self):
+                self.requested = False
+
+            def request(self, reason):
+                self.requested = True
+
+
+        def install(drain):
+            def handler(signum, frame):
+                drain.request("signal")
+            signal.signal(signal.SIGTERM, handler)
+    """))
+    files, handlers, violations = scan_signal_handlers(str(pkg))
+    assert files == 2 and handlers == 2
+    calls = sorted(v[3] for v in violations)
+    assert calls == ["logger.warning", "print"]
+    assert all(v[0].endswith("bad_handlers.py") for v in violations)
+
+
+# -- the seeded-bad demo: exact rule set ------------------------------------
+
+
+def test_badfault_reports_exactly_the_seeded_rules():
+    report = run_fault_target(FAULT_TARGETS["badfault"])
+    assert rules_in(report.findings) == ["RKT1001", "RKT1002", "RKT1003"]
+
+
+def test_fault_family_registered():
+    from rocket_tpu.analysis.__main__ import AUDIT_SUBCOMMANDS
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    cli = AUDIT_SUBCOMMANDS["fault"]
+    assert cli.budget_rule == "RKT1006"
+    assert getattr(budgets_mod, cli.gated_keys_attr) == (
+        "crash_points", "states_explored", "handlers_checked",
+        "coverage_fingerprint",
+    )
+    assert [r[0] for r in FAULT_RULES] == [
+        f"RKT100{i}" for i in range(1, 7)
+    ]
+
+
+def test_fault_budget_gate_catches_coverage_shrink():
+    from rocket_tpu.analysis import budgets as budgets_mod
+
+    committed = {"crash_points": 66,
+                 "coverage_fingerprint": "prefixes=66 save=21"}
+    shrunk = {"crash_points": 50,
+              "coverage_fingerprint": "prefixes=50 save=15"}
+    findings = budgets_mod.diff_budget(
+        "ckpt_protocol", committed, shrunk,
+        keys=budgets_mod.FAULT_GATED_KEYS, rule="RKT1006", family="fault",
+    )
+    # numeric growth gating alone would wave a SHRINK through; the
+    # fingerprint identity key is what refuses silent coverage loss
+    assert rules_in(findings) == ["RKT1006"]
+    assert any("coverage_fingerprint" in f.message for f in findings)
+
+
+# -- multi-host skewed drain: torn layouts must not be resumable ------------
+
+
+def _index_two_shards():
+    return {"w": {
+        "kind": "array", "shape": [8], "dtype": "float64",
+        "chunks": [
+            {"file": "shard_p0.npz", "key": "w:0", "index": [[0, 4]]},
+            {"file": "shard_p1.npz", "key": "w:4", "index": [[4, 8]]},
+        ],
+    }}
+
+
+def _write_rank(step_dir, process, local):
+    checkpoint_io.write_snapshot(
+        os.path.join(step_dir, "model_0"),
+        {"process": process, "index": _index_two_shards(), "local": local},
+    )
+
+
+def test_skewed_drain_layouts_fall_back_to_last_complete_step(tmp_path):
+    root = str(tmp_path)
+    # Step 3: the last periodic save BOTH ranks completed.
+    step3 = os.path.join(root, "3")
+    _write_rank(step3, 0, {"w:0": np.arange(4.0)})
+    _write_rank(step3, 1, {"w:4": np.arange(4.0, 8.0)})
+    checkpoint_io.atomic_write(
+        os.path.join(step3, "rng.json"), json.dumps({"c": 1}).encode())
+    assert is_complete_checkpoint(step3)
+
+    # Step 5: rank 0 drained here — wrote its shard, the index and the
+    # rng marker, but rank 1 never drained at this step: its shard is
+    # missing, so the index references a file that does not exist.
+    step5 = os.path.join(root, "5")
+    _write_rank(step5, 0, {"w:0": np.arange(4.0)})
+    checkpoint_io.atomic_write(
+        os.path.join(step5, "rng.json"), json.dumps({"c": 2}).encode())
+    assert not is_complete_checkpoint(step5)
+
+    # Step 7: rank 1 drained here — shard only, no index, no marker.
+    step7 = os.path.join(root, "7")
+    _write_rank(step7, 1, {"w:4": np.arange(4.0, 8.0)})
+    assert not is_complete_checkpoint(step7)
+
+    # Resume must skip BOTH torn layouts and land on step 3, and the
+    # step it lands on must actually reassemble.
+    assert newest_complete_step(root) == 3
+    tree = checkpoint_io.load_pytree(os.path.join(step3, "model_0"))
+    np.testing.assert_array_equal(tree["w"], np.arange(8.0))
