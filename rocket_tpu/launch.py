@@ -345,6 +345,11 @@ def _add_supervise_args(parser: argparse.ArgumentParser) -> None:
     sup.add_argument("--state-dir", default=os.path.join("runs", "supervised"),
                      help="where supervisor.json is written "
                      "(default: runs/supervised)")
+    sup.add_argument("--metrics-port", type=int, default=None,
+                     help="mount the supervisor's own Prometheus /metrics "
+                     "endpoint on this port (0 = ephemeral): restart and "
+                     "per-generation goodput counters that survive worker "
+                     "death")
 
 
 def main(argv=None) -> int:
@@ -389,6 +394,7 @@ def main(argv=None) -> int:
             coordinator_port=args.coordinator_port,
             term_grace_s=args.term_grace,
             drain_grace_s=args.drain_grace,
+            metrics_port=args.metrics_port,
         )
         supervisor.install_signal_handlers()
         return supervisor.run()

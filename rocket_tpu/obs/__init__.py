@@ -13,8 +13,26 @@ health sentinels into the compiled train step (``obs/health.py``) and
 arms the flight recorder (``obs/flight.py``) whose forensic bundles land
 under ``<runs dir>/blackbox/`` — render with
 ``python -m rocket_tpu.obs blackbox <bundle>``. See docs/observability.md.
+
+``Runtime(export=True)`` (or ``ROCKET_TPU_EXPORT=1``) arms the *live*
+plane (``obs/export.py``): streaming JSONL metric shards under
+``<runs dir>/telemetry/rank<k>.jsonl``, an optional Prometheus
+``/metrics`` endpoint (``metrics_port=`` / ``ROCKET_TPU_METRICS_PORT``),
+and continuous SLO burn-rate evaluation (``obs/slo.py``). Tail a live
+run with ``python -m rocket_tpu.obs top <run dir>``; gate CI with
+``python -m rocket_tpu.obs watch <run dir> --slo default:serve``.
 """
 
+from rocket_tpu.obs.export import (
+    ExportConfig,
+    PrometheusServer,
+    ShardWriter,
+    TelemetryExporter,
+    host_identity,
+    merge_rank_records,
+    read_telemetry_dir,
+    render_prometheus,
+)
 from rocket_tpu.obs.flight import FlightRecorder
 from rocket_tpu.obs.goodput import CATEGORIES, Goodput, render_report
 from rocket_tpu.obs.health import (
@@ -29,6 +47,12 @@ from rocket_tpu.obs.registry import (
     MetricsRegistry,
     estimate_quantiles,
 )
+from rocket_tpu.obs.slo import (
+    SLOEvaluator,
+    SLOSpec,
+    SLOStatus,
+    load_slo_specs,
+)
 from rocket_tpu.obs.spans import SpanRecorder, load_chrome_trace
 from rocket_tpu.obs.telemetry import Telemetry
 from rocket_tpu.obs.watchdog import Watchdog
@@ -36,6 +60,7 @@ from rocket_tpu.obs.watchdog import Watchdog
 __all__ = [
     "CATEGORIES",
     "Counter",
+    "ExportConfig",
     "FlightRecorder",
     "Gauge",
     "Goodput",
@@ -44,10 +69,21 @@ __all__ = [
     "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "PrometheusServer",
+    "SLOEvaluator",
+    "SLOSpec",
+    "SLOStatus",
+    "ShardWriter",
     "SpanRecorder",
     "Telemetry",
+    "TelemetryExporter",
     "Watchdog",
     "estimate_quantiles",
+    "host_identity",
     "load_chrome_trace",
+    "load_slo_specs",
+    "merge_rank_records",
+    "read_telemetry_dir",
+    "render_prometheus",
     "render_report",
 ]

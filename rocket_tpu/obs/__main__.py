@@ -1,4 +1,4 @@
-"""CLI: ``python -m rocket_tpu.obs <report|blackbox|prof> <path>``.
+"""CLI: ``python -m rocket_tpu.obs <report|top|watch|blackbox|prof> <path>``.
 
 ``report`` renders a run's telemetry record as the goodput table plus the
 key registry metrics (histograms as estimated p50/p90/p99 rows, and a
@@ -10,7 +10,20 @@ recorded" row (never a crash on the degenerate record). Given a
 ``supervisor.json`` (a supervised launch's state file) it renders the
 per-generation table + goodput-under-failures headline; a
 supervisor.json sitting next to the telemetry record is folded into the
-same report.
+same report. Given a *directory with no telemetry.json* (a worker died
+before DESTROY), it falls back to the streaming shards the live
+exporter left behind and renders their last snapshot.
+
+``top`` tails a live run's streaming shards
+(``<run dir>/telemetry/rank<k>.jsonl``, written by the
+:mod:`rocket_tpu.obs.export` plane) and renders a refreshing cross-rank
+view: counters summed, every gauge's sum/mean/min/max/skew with
+slowest-rank attribution, merged latency percentiles. ``--once``
+renders a single frame (tests, piping).
+
+``watch --slo <spec>`` replays the shards through the SLO evaluator
+(:mod:`rocket_tpu.obs.slo`) and exits 1 when any rank violated an
+objective — the CI gate for "the run stayed inside its SLOs".
 
 ``blackbox`` renders a flight-recorder forensic bundle
 (``runs/<project>/blackbox/<reason>/``, or its ``blackbox.json``
@@ -27,7 +40,7 @@ error, top offenders with source attribution) — the interactive face of
 ``python -m rocket_tpu.analysis calib``.
 
 Exit contract matches the analysis CLIs: 0 = rendered, 2 = usage/parse
-error.
+error; ``watch`` adds 1 = SLO violation.
 """
 
 from __future__ import annotations
@@ -265,9 +278,12 @@ def _render_blackbox(manifest: dict, bundle_dir: str) -> str:
     ]
     process = manifest.get("process")
     if process:
+        where = (
+            f" on {process.get('hostname')}" if process.get("hostname") else ""
+        )
         lines.append(
-            f"process: {process.get('index')}/{process.get('count')} "
-            f"(pid {process.get('pid')})"
+            f"process: {process.get('index')}/{process.get('count')}"
+            f"{where} (pid {process.get('pid')})"
         )
     health = manifest.get("health")
     if health:
@@ -347,6 +363,191 @@ def _render_blackbox(manifest: dict, bundle_dir: str) -> str:
         lines.append("watchdog report:")
         lines.append(str(extra["report"]))
     return "\n".join(lines)
+
+
+def _latest_per_rank(path: str) -> dict[int, dict]:
+    """Each rank's newest shard record under a run/telemetry dir."""
+    from rocket_tpu.obs.export import read_telemetry_dir
+
+    return {
+        rank: records[-1]
+        for rank, records in read_telemetry_dir(path).items()
+        if records
+    }
+
+
+def _render_top(latest: dict[int, dict]) -> str:
+    """One frame of the cross-rank live view over the newest shard
+    record per rank: per-rank liveness header, counters summed,
+    gauge spread stats with slowest-rank attribution, merged latency
+    percentiles."""
+    import time as _time
+
+    from rocket_tpu.obs.export import merge_rank_records
+
+    merged = merge_rank_records(latest)
+    now = _time.time()
+    lines = [
+        f"obs top — {len(latest)} rank(s)",
+        f"  {'rank':>4} {'hostname':<20} {'pid':>7} {'seq':>6} "
+        f"{'uptime_s':>9} {'age_s':>6} {'goodput':>8}",
+    ]
+    for rank in sorted(latest):
+        rec = latest[rank]
+        age = now - rec.get("t_unix", now)
+        goodput = (rec.get("goodput") or {}).get("goodput_fraction")
+        lines.append(
+            f"  {rank:>4} {str(rec.get('hostname', '?'))[:20]:<20} "
+            f"{rec.get('pid', '?'):>7} {rec.get('seq', '?'):>6} "
+            f"{_fmt(rec.get('uptime_s')):>9} {age:>6.1f} "
+            f"{_fmt(goodput):>8}"
+        )
+    if merged["counters"]:
+        lines.append("")
+        lines.append("counters (summed across ranks):")
+        for name in sorted(merged["counters"]):
+            lines.append(f"  {name:<40} {merged['counters'][name]:g}")
+    if merged["gauges"]:
+        lines.append("")
+        lines.append("gauges (spread across ranks):")
+        lines.append(
+            f"  {'name':<40} {'mean':>10} {'min':>10} {'max':>10} "
+            f"{'skew':>6}  slowest"
+        )
+        for name in sorted(merged["gauges"]):
+            stat = merged["gauges"][name]
+            # "Slowest" = the arg-max rank: for a duration/depth gauge
+            # the biggest value is the rank dragging the fleet.
+            lines.append(
+                f"  {name:<40} {_fmt(stat['mean']):>10} "
+                f"{_fmt(stat['min']):>10} {_fmt(stat['max']):>10} "
+                f"{_fmt(stat['skew'], 3):>6}  rank {stat['max_rank']}"
+            )
+    if merged["histograms"]:
+        lines.append("")
+        lines.append("histograms (merged):")
+        for name in sorted(merged["histograms"]):
+            hist = merged["histograms"][name]
+            quantiles = estimate_quantiles(hist)
+            tail = "".join(
+                f" {q}={quantiles[q]:.4g}" for q in ("p50", "p90", "p99")
+                if q in quantiles
+            )
+            mean = hist.get("mean")
+            lines.append(
+                f"  {name:<40} count={hist.get('count', 0)}"
+                + (f" mean={mean:.4g}" if mean is not None else "")
+                + tail
+            )
+    return "\n".join(lines)
+
+
+def _top(args) -> int:
+    latest = _latest_per_rank(args.path)
+    if not latest:
+        print(f"error: no telemetry shards (rank*.jsonl) under {args.path} "
+              "— is the run exporting? (ROCKET_TPU_EXPORT=1 / "
+              "Runtime(export=True))", file=sys.stderr)
+        return 2
+    if args.once:
+        print(_render_top(latest))
+        return 0
+    import time as _time
+
+    try:
+        while True:
+            latest = _latest_per_rank(args.path)
+            # ANSI clear + home — a refreshing full-screen frame.
+            sys.stdout.write("\x1b[2J\x1b[H" + _render_top(latest) + "\n")
+            sys.stdout.flush()
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _watch(args) -> int:
+    """Replay every rank's shard records through the SLO evaluator;
+    exit 1 when any rank ends in violation of any objective."""
+    from rocket_tpu.obs.export import read_telemetry_dir
+    from rocket_tpu.obs.slo import SLOEvaluator, load_slo_specs
+
+    try:
+        specs = load_slo_specs(args.slo)
+    except (OSError, ValueError) as exc:
+        print(f"error: cannot load SLO specs from {args.slo!r}: {exc}",
+              file=sys.stderr)
+        return 2
+    shards = read_telemetry_dir(args.path)
+    if not shards:
+        print(f"error: no telemetry shards (rank*.jsonl) under {args.path}",
+              file=sys.stderr)
+        return 2
+    violated: dict[str, dict] = {}
+    evaluated = 0
+    for rank in sorted(shards):
+        # Per-rank evaluator: the burn-rate windows are a single
+        # process's history, exactly as the live exporter computes them.
+        evaluator = SLOEvaluator(specs)
+        for record in shards[rank]:
+            statuses = evaluator.observe(
+                record.get("t_unix", 0.0),
+                record.get("metrics") or {},
+                record.get("goodput") or {},
+            )
+            evaluated += 1
+            for status in statuses:
+                if status.violated:
+                    violated[f"{status.name}@rank{rank}"] = {
+                        "rank": rank,
+                        "name": status.name,
+                        "burn_rate": status.burn_rate,
+                        "value": status.value,
+                        "objective": status.objective,
+                    }
+    names = ", ".join(s.name for s in specs)
+    print(
+        f"obs watch — {len(specs)} SLO(s) [{names}] over "
+        f"{len(shards)} rank shard(s), {evaluated} record(s)"
+    )
+    if not violated:
+        print("all SLOs within objective")
+        return 0
+    for key in sorted(violated):
+        v = violated[key]
+        print(
+            f"VIOLATION {v['name']} (rank {v['rank']}): "
+            f"burn_rate={_fmt(v['burn_rate'])} value={_fmt(v['value'])} "
+            f"objective={_fmt(v['objective'])}"
+        )
+    return 1
+
+
+def _report_from_shards(path: str) -> int:
+    """The ``report`` fallback for a run dir with no telemetry.json —
+    a worker killed before DESTROY still left its streaming shards."""
+    latest = _latest_per_rank(path)
+    if not latest:
+        print(
+            f"error: no telemetry.json and no streaming shards under "
+            f"{path}", file=sys.stderr,
+        )
+        return 2
+    if len(latest) == 1:
+        (rank, record), = latest.items()
+        doc = {
+            "goodput": record.get("goodput") or {},
+            "metrics": record.get("metrics") or {},
+        }
+        print(
+            f"(reconstructed from streaming shards: rank {rank} seq "
+            f"{record.get('seq')}, no telemetry.json — worker died "
+            "before DESTROY?)"
+        )
+        print(_report_telemetry(doc))
+        return 0
+    print("(reconstructed from streaming shards — no telemetry.json)")
+    print(_render_top(latest))
+    return 0
 
 
 def _prof(args) -> int:
@@ -442,9 +643,35 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="command")
     report = sub.add_parser(
-        "report", help="render telemetry.json or a Chrome-trace span file"
+        "report", help="render telemetry.json, a run dir (falls back to "
+                       "streaming shards) or a Chrome-trace span file"
     )
-    report.add_argument("path", help="telemetry.json or spans.trace.json")
+    report.add_argument(
+        "path", help="telemetry.json, spans.trace.json, or a run dir"
+    )
+    top = sub.add_parser(
+        "top", help="live cross-rank view over a run's streaming "
+                    "telemetry shards"
+    )
+    top.add_argument(
+        "path", help="run dir (or its telemetry/ dir) holding rank*.jsonl"
+    )
+    top.add_argument("--once", action="store_true",
+                     help="render one frame and exit (no refresh loop)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh cadence in seconds (default: 2)")
+    watch = sub.add_parser(
+        "watch", help="evaluate SLO specs over a run's streaming shards; "
+                      "exit 1 on violation"
+    )
+    watch.add_argument(
+        "path", help="run dir (or its telemetry/ dir) holding rank*.jsonl"
+    )
+    watch.add_argument(
+        "--slo", required=True, metavar="SPEC",
+        help="SLO spec file (rocket_tpu.obs.slo grammar), or "
+             "default:serve / default:train",
+    )
     blackbox = sub.add_parser(
         "blackbox", help="render a flight-recorder forensic bundle"
     )
@@ -477,6 +704,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.command == "prof":
         return _prof(args)
+    if args.command == "top":
+        return _top(args)
+    if args.command == "watch":
+        return _watch(args)
     if args.command not in ("report", "blackbox"):
         parser.print_help()
         return 2
@@ -498,6 +729,18 @@ def main(argv=None) -> int:
             return 2
         print(_render_blackbox(manifest, bundle_dir))
         return 0
+
+    if os.path.isdir(path):
+        # A run dir: prefer the DESTROY-time record, then the
+        # supervisor's state file, then the live exporter's streaming
+        # shards — a worker killed before DESTROY leaves only those.
+        for name in ("telemetry.json", "supervisor.json"):
+            candidate = os.path.join(path, name)
+            if os.path.exists(candidate):
+                path = candidate
+                break
+        else:
+            return _report_from_shards(path)
 
     try:
         with open(path, "r", encoding="utf-8") as f:

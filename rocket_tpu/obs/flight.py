@@ -192,14 +192,21 @@ class FlightRecorder:
             "anomalies": anomalies,
             "extra": _jsonable(extra) if extra is not None else None,
         }
+        telemetry = self._telemetry
         if self._runtime is not None:
+            # Rank + hostname ride the manifest so multi-host forensics
+            # can attribute the bundle without the launcher's context.
+            from rocket_tpu.obs.export import host_identity
+
+            identity = host_identity(self._runtime.process_index)
             manifest["process"] = {
                 "index": self._runtime.process_index,
                 "count": self._runtime.process_count,
+                "rank": identity["rank"],
+                "hostname": identity["hostname"],
                 "pid": os.getpid(),
             }
             manifest["rng"] = self._runtime.rng_state_dict()
-        telemetry = self._telemetry
         if telemetry is not None:
             manifest["metrics"] = telemetry.registry.snapshot()
             events = telemetry.spans.events()[-self._spans_tail:]

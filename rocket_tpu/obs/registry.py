@@ -41,6 +41,10 @@ class Counter:
         with self._lock:
             self._value += amount
 
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
     @property
     def value(self) -> float:
         return self._value
@@ -90,6 +94,18 @@ class Histogram:
             ratio = max(value, 0.0) / self.base
             exponent = 0 if ratio <= 1.0 else math.ceil(math.log2(ratio))
             self.buckets[exponent] = self.buckets.get(exponent, 0) + 1
+
+    def reset(self) -> None:
+        """Zero the distribution in place (epoch mark): same instrument
+        object, so holders of the handle keep observing into it —
+        ``ServeEngine.reset_metrics()`` windows the latency histograms
+        to the warm steady state this way."""
+        with self._lock:
+            self.count = 0
+            self.total = 0.0
+            self.min = None
+            self.max = None
+            self.buckets = {}
 
     @property
     def mean(self) -> Optional[float]:
@@ -181,6 +197,22 @@ class MetricsRegistry:
             if instrument is None:
                 instrument = self._histograms[name] = Histogram(base=base)
             return instrument
+
+    def reset(self, prefix: str = "") -> int:
+        """Reset every counter and histogram whose name starts with
+        ``prefix`` (gauges are last-write-wins and simply re-publish).
+        Returns the number of instruments reset. The instruments stay
+        registered — handles held by instrumented code keep working."""
+        with self._lock:
+            matched = [
+                instrument
+                for name, instrument in (*self._counters.items(),
+                                         *self._histograms.items())
+                if name.startswith(prefix)
+            ]
+        for instrument in matched:
+            instrument.reset()
+        return len(matched)
 
     # -- device / jax sources ---------------------------------------------
 

@@ -108,6 +108,16 @@ class Telemetry:
         self.spans = SpanRecorder(max_events=max_span_events)
         self.goodput = Goodput()
         self.registry = MetricsRegistry()
+        #: Process identity (rank/hostname/pid) stamped into shard
+        #: records, stall-dump headers and black-box manifests. Env-based
+        #: here (JAX_PROCESS_ID, pre-backend); the Runtime refreshes the
+        #: rank from jax.process_index() once initialized.
+        from rocket_tpu.obs.export import host_identity
+
+        self.identity = host_identity()
+        #: Live-export plane (rocket_tpu.obs.export), attached via
+        #: :meth:`start_export`; None keeps the run post-hoc only.
+        self.exporter = None
         #: Runtime-wired (rocket_tpu.obs.flight / .health): the flight
         #: recorder and health monitor for this run, when health sentinels
         #: are enabled. None otherwise — every use below is guarded.
@@ -145,7 +155,27 @@ class Telemetry:
         self.spans.t0 = self._t0
         self._register_compile_listener()
         if self.watchdog is not None:
+            self.watchdog.identity = self.identity
             self.watchdog.start()
+
+    def start_export(self, config, default_dir: Optional[str] = None) -> None:
+        """Attach + start the live-export plane (streaming shards, the
+        ``/metrics`` endpoint, continuous SLO evaluation) per the
+        :class:`~rocket_tpu.obs.export.ExportConfig`. No-op when the
+        config is inactive or telemetry is disabled; idempotent."""
+        if not self.enabled or self.exporter is not None:
+            return
+        if not getattr(config, "active", False):
+            return
+        from rocket_tpu.obs.export import TelemetryExporter
+
+        self.exporter = TelemetryExporter(
+            self, config,
+            identity=self.identity,
+            default_dir=default_dir,
+            logger=self._logger,
+        )
+        self.exporter.start()
 
     def _register_compile_listener(self) -> None:
         if self._monitoring_listener is not None:
@@ -290,6 +320,21 @@ class Telemetry:
         self.registry.gauge("obs/spans_dropped").set(self.spans.dropped)
         return self.registry.scalars()
 
+    def live_snapshot(self) -> dict:
+        """Registry snapshot with the goodput fractions re-published as
+        gauges first — what the /metrics endpoint and the shard exporter
+        serve. Unlike :meth:`scalars_snapshot` it skips the device-memory
+        refresh: a scrape storm must stay pure host arithmetic."""
+        if self.enabled:
+            report = self.goodput.report(time.perf_counter() - self._t0)
+            for cat, fraction in report["fractions"].items():
+                self.registry.gauge(f"goodput/{cat}_fraction").set(fraction)
+            self.registry.gauge("goodput/goodput_fraction").set(
+                report["goodput_fraction"]
+            )
+            self.registry.gauge("obs/spans_dropped").set(self.spans.dropped)
+        return self.registry.snapshot()
+
     def summary(self) -> dict:
         """The telemetry.json payload."""
         total = time.perf_counter() - self._t0
@@ -358,6 +403,11 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        if self.exporter is not None:
+            # Final shard record + endpoint teardown BEFORE the summary
+            # flush: the last snapshot a scraper/shard reader sees is
+            # the one telemetry.json freezes.
+            self.exporter.stop()
         if self.enabled and self.spans.dropped and self._logger is not None:
             # One loud line at teardown: the span file is a TRUNCATED view.
             self._logger.warning(

@@ -70,6 +70,10 @@ class Watchdog:
         self._consecutive_stalls = 0
         self._escalated = False
         self.escalation_count = 0
+        #: Process identity (rank/hostname/pid) for the dump header —
+        #: multi-host forensics must attribute the wedged rank. Set by
+        #: Telemetry.start(); None renders no identity line.
+        self.identity: Optional[dict] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -151,6 +155,12 @@ class Watchdog:
             f"rocket_tpu watchdog: no step completed for {stalled_for:.1f}s "
             f"(deadline {self.deadline_s:.1f}s) — dumping diagnostics",
         ]
+        if self.identity:
+            lines.append(
+                f"process: rank {self.identity.get('rank')} on "
+                f"{self.identity.get('hostname')} "
+                f"(pid {self.identity.get('pid')})"
+            )
         if self._spans is not None:
             open_spans = self._spans.open_spans()
             if open_spans:

@@ -389,6 +389,7 @@ class Supervisor:
         coordinator_port: Optional[int] = None,
         term_grace_s: float = 10.0,
         drain_grace_s: float = 60.0,
+        metrics_port: Optional[int] = None,
         extra_env: Optional[dict] = None,
         run_generation: Optional[Callable] = None,
         sleep: Callable[[float], None] = None,
@@ -406,6 +407,14 @@ class Supervisor:
         self.coordinator_port = coordinator_port
         self.term_grace_s = float(term_grace_s)
         self.drain_grace_s = float(drain_grace_s)
+        #: Mount the supervisor's own Prometheus /metrics endpoint on
+        #: this port (0 = ephemeral): per-generation goodput, restart
+        #: and outcome counters survive worker death — the workers' own
+        #: endpoints die with them, this one doesn't.
+        self.metrics_port = metrics_port
+        self.registry = None
+        self._metrics_server = None
+        self._published_gens = 0
         self.extra_env = dict(extra_env or {})
         self._run_generation = run_generation or self._run_generation_default
         self._clock = clock
@@ -522,12 +531,78 @@ class Supervisor:
         )
         return rc, codes, group.output_tail(), group.coord_error.is_set()
 
+    # -- the supervisor's own metrics plane --------------------------------
+
+    def _start_metrics(self) -> None:
+        """Mount /metrics when asked. The registry + server come from
+        rocket_tpu.obs (registry.py / export.py are stdlib-only at
+        module level), so the supervisor stays jax-free and
+        signal-safe."""
+        if self.metrics_port is None or self._metrics_server is not None:
+            return
+        from rocket_tpu.obs.export import PrometheusServer
+        from rocket_tpu.obs.registry import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        try:
+            self._metrics_server = PrometheusServer(
+                self.registry.snapshot, self.metrics_port,
+                labels={"role": "supervisor"},
+            )
+            self._metrics_server.start()
+            self._log(
+                f"/metrics on http://{self._metrics_server.host}:"
+                f"{self._metrics_server.port}"
+            )
+        except OSError as exc:
+            self._metrics_server = None
+            self._log(f"could not bind /metrics port "
+                      f"{self.metrics_port}: {exc!r}")
+
+    def _stop_metrics(self) -> None:
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.stop()
+
+    def _publish_metrics(self) -> None:
+        """Re-export the supervision state the scrape plane can watch:
+        restart/drain/outcome counts, the current topology, and the
+        headline goodput fraction. Idempotent per generation — outcome
+        counters advance only over generations not yet published."""
+        registry = self.registry
+        if registry is None:
+            return
+        doc = self.summary()
+        registry.gauge("supervisor/restarts").set(self.restarts)
+        registry.gauge("supervisor/drain_events").set(self.drain_signals)
+        registry.gauge("supervisor/generations").set(len(self.generations))
+        registry.gauge("supervisor/goodput_fraction").set(
+            doc["goodput_fraction"]
+        )
+        registry.gauge("supervisor/total_wall_s").set(doc["total_wall_s"])
+        registry.gauge("supervisor/productive_wall_s").set(
+            doc["productive_wall_s"]
+        )
+        if self.generations:
+            registry.gauge("supervisor/nproc").set(self.generations[-1].nproc)
+        if self._last_ckpt_step is not None:
+            registry.gauge("supervisor/last_ckpt_step").set(
+                self._last_ckpt_step
+            )
+        for record in self.generations[self._published_gens:]:
+            if record.outcome:
+                registry.counter(
+                    f"supervisor/outcomes/{record.outcome}"
+                ).inc()
+        self._published_gens = len(self.generations)
+
     # -- the loop ----------------------------------------------------------
 
     def run(self) -> int:
         policy = self.policy
         state = LoopState(nproc=self.nproc)
         gen = 0
+        self._start_metrics()
 
         while True:
             record = GenerationRecord(
@@ -660,6 +735,7 @@ class Supervisor:
         self.outcome = outcome
         self.rc = rc
         self._write_state()
+        self._stop_metrics()
         self._log(f"supervisor: {outcome} (rc={rc})")
         return rc
 
@@ -685,6 +761,7 @@ class Supervisor:
         }
 
     def _write_state(self) -> None:
+        self._publish_metrics()
         try:
             os.makedirs(self.state_dir, exist_ok=True)
             path = os.path.join(self.state_dir, SUPERVISOR_FILE)

@@ -329,6 +329,26 @@ class Runtime:
         How many steps behind the health word is fetched; by then the
         producing step has retired, so the explicit device_get cannot
         stall the dispatch pipeline (sync-free under strict mode).
+    export:
+        Opt into live telemetry export (``rocket_tpu.obs.export``): a
+        daemon thread appends periodic registry snapshots + the goodput
+        report as bounded JSONL shards to
+        ``<run dir>/telemetry/rank<k>.jsonl`` and evaluates SLO specs
+        (``slo=``). None (default) reads ``ROCKET_TPU_EXPORT`` — truthy
+        enables, a number enables AND sets the interval. An active
+        export implies ``telemetry=True`` when ``telemetry`` is unset.
+    export_interval_s:
+        Seconds between exporter ticks (default 10).
+    metrics_port:
+        Mount a Prometheus ``/metrics`` endpoint (text exposition 0.0.4,
+        stdlib http.server thread) on this port + the process rank
+        (0 = ephemeral). None (default) reads ``ROCKET_TPU_METRICS_PORT``.
+        Implies ``telemetry=True`` like ``export``.
+    slo:
+        SLO spec file path (``rocket_tpu.obs.slo`` grammar) or
+        ``default:train`` / ``default:serve`` for the committed specs;
+        violations surface as ``obs/slo/*`` gauges, a flight-recorder
+        anomaly and a log line. None reads ``ROCKET_TPU_SLO``.
     """
 
     #: Name of the batch-sharded mesh axis group. Parallel schemes that shard
@@ -365,6 +385,10 @@ class Runtime:
         anomaly_action: Optional[str] = None,
         blackbox_steps: int = 256,
         health_fetch_lag: int = 2,
+        export: Optional[bool] = None,
+        export_interval_s: Optional[float] = None,
+        metrics_port: Optional[int] = None,
+        slo: Optional[str] = None,
     ) -> None:
         _enable_compilation_cache()
         _maybe_initialize_distributed()
@@ -465,11 +489,24 @@ class Runtime:
                 env_health if env_health in ANOMALY_ACTIONS else "warn"
             )
 
+        # Live export plane (rocket_tpu.obs.export): streaming JSONL
+        # shards + optional /metrics endpoint + SLO evaluation. Resolved
+        # early because an active export implies telemetry below.
+        from rocket_tpu.obs.export import ExportConfig, host_identity
+
+        export_cfg = ExportConfig.from_env(
+            enabled=export,
+            interval_s=export_interval_s,
+            metrics_port=metrics_port,
+            slo_path=slo,
+        )
+
         if telemetry is None:
-            if watchdog_secs is not None or health:
-                # An explicit watchdog_secs= or health=True is an explicit
-                # ask for hang protection / health forensics; both live
-                # inside telemetry, so the ask implies the subsystem
+            if watchdog_secs is not None or health or export_cfg.active:
+                # An explicit watchdog_secs=, health=True or an active
+                # export config is an explicit ask for hang protection /
+                # health forensics / live metrics; all live inside
+                # telemetry, so the ask implies the subsystem
                 # rather than silently no-opping.
                 telemetry = True
             else:
@@ -526,7 +563,14 @@ class Runtime:
         )
         self.telemetry.flight = self.flight
         self.telemetry.health = self.health
+        # Replace the env-guessed rank with the real one before start()
+        # hands identity to the watchdog and the exporter stamps shards.
+        self.telemetry.identity = host_identity(self.process_index)
         self.telemetry.start()
+        self.telemetry.start_export(
+            export_cfg,
+            default_dir=os.path.join(project_dir, "runs", "telemetry"),
+        )
 
         # Resilience plumbing (rocket_tpu.resilience): the drain flag every
         # Looper polls at wave boundaries, deterministic fault injection

@@ -111,9 +111,29 @@ def _run(args) -> int:
     from rocket_tpu.obs.telemetry import Telemetry
     from rocket_tpu.serve.api import ServeConfig, ServeEngine
 
+    from rocket_tpu.obs.export import ExportConfig
+
     model, params, tokenizer = _build_model(args)
     telemetry = Telemetry(enabled=True, out_dir=args.out_dir)
     telemetry.start()
+    # Live plane: --metrics-port mounts /metrics, --export streams JSONL
+    # shards, --slo arms continuous burn-rate evaluation (default:serve
+    # ships ITL/TTFT p99 objectives derived from the static roofline).
+    telemetry.start_export(
+        ExportConfig.from_env(
+            enabled=args.export or None,
+            interval_s=args.export_interval,
+            metrics_port=args.metrics_port,
+            slo_path=args.slo,
+        ),
+        default_dir=args.out_dir,
+    )
+    exporter = telemetry.exporter
+    if exporter is not None and exporter.server is not None:
+        print(
+            f"serve: /metrics on http://{exporter.server.host}:"
+            f"{exporter.server.port}", file=sys.stderr,
+        )
     engine = ServeEngine(
         model, params,
         ServeConfig(
@@ -268,6 +288,19 @@ def main(argv=None) -> int:
         p.add_argument("--trace-dir", default=None,
                        help="trace output dir (default <out-dir>/traces)")
         p.add_argument("--out-dir", default=os.path.join("runs", "serve"))
+        p.add_argument("--metrics-port", type=int, default=None,
+                       help="mount a Prometheus /metrics endpoint on this "
+                       "port (0 = ephemeral; env ROCKET_TPU_METRICS_PORT)")
+        p.add_argument("--export", action="store_true",
+                       help="stream registry snapshots as JSONL shards to "
+                       "<out-dir>/telemetry/rank<k>.jsonl "
+                       "(env ROCKET_TPU_EXPORT)")
+        p.add_argument("--export-interval", type=float, default=None,
+                       metavar="SECS", help="exporter tick cadence "
+                       "(default 10)")
+        p.add_argument("--slo", default=None, metavar="SPEC",
+                       help="SLO spec file, or default:serve for the "
+                       "committed ITL/TTFT objectives (env ROCKET_TPU_SLO)")
 
     rep = sub.add_parser("report", help="render a serve telemetry.json")
     rep.add_argument("path", help="telemetry.json or the run dir holding it")

@@ -284,7 +284,15 @@ class ServeEngine:
                         )
                 else:
                     # Amortized inter-token latency for this batch.
-                    self._itl.append((now - prev) / batch[req.id])
+                    itl = (now - prev) / batch[req.id]
+                    self._itl.append(itl)
+                    if self.telemetry is not None and self.telemetry.enabled:
+                        # Registry-side distribution: what /metrics and
+                        # the ITL-p99 SLO watch live, across resets of
+                        # the host-list aggregates.
+                        self.telemetry.registry.histogram(
+                            "serve/itl_s", base=1e-6
+                        ).observe(itl)
                 if ev.finished:
                     self._last_emit.pop(req.id, None)
                     self._finish_span(req)
@@ -452,10 +460,18 @@ class ServeEngine:
         counters, which are the engine-lifetime no-retrace proof. Call
         while idle (e.g. after a warmup ``drain()``): benchmarks warm the
         compiled steps with a few requests, reset, then measure
-        steady-state serving without compile time in the percentiles."""
+        steady-state serving without compile time in the percentiles.
+
+        Also windows the registry-side ``serve/*`` histograms
+        (``serve/ttft_s``, ``serve/itl_s``): the Prometheus endpoint and
+        ``telemetry.json`` percentiles must describe the same
+        steady-state window the report does, not the warmup spikes the
+        host lists just dropped."""
         with self._lock:
             self._ttft.clear()
             self._itl.clear()
+            if self.telemetry is not None and self.telemetry.enabled:
+                self.telemetry.registry.reset("serve/")
             self._first_wave_at = None
             self._last_event_at = None
             self._occupancy_sum = 0

@@ -15,6 +15,15 @@ per-op attribution table; then ``python -m rocket_tpu.analysis calib
 sentinel step, reconcile it against the priced optimized-HLO DAG and
 hold the committed calibration budget (exit 0).
 
+The live-export leg (ISSUE 19): the same run streams telemetry shards
+(``Runtime(export=True)``) and mounts the ``/metrics`` endpoint
+(``metrics_port=0``), still under the strict guards — exporting must add
+zero device syncs. A poller scrapes mid-run (the endpoint tears down
+with the run) and the scrape must carry goodput + SLO families; a
+seeded SLO violation must be detected online (``obs/slo/*`` counter)
+and gate offline (``obs watch`` exit 1), while a slack spec passes;
+``obs top --once`` must render the shard fleet view.
+
 Exits non-zero on the first violated invariant (wired into
 scripts/check.sh and CI).
 """
@@ -25,6 +34,8 @@ import re
 import subprocess
 import sys
 import tempfile
+import threading
+import urllib.request
 
 # Same backend bootstrap as tests/conftest.py: 8 virtual CPU devices,
 # configured before jax picks a backend.
@@ -78,17 +89,56 @@ def main() -> None:
          "label": np.int32(i % 4)}
         for i in range(256)
     ]
+    # Seeded SLO specs with deterministic verdicts (the committed
+    # default:train/serve specs encode TPU roofline objectives — CPU toy
+    # timing would make their verdicts flaky here). health/grad_norm is
+    # a positive gauge on every clean run: a ceiling of 1e-30 MUST
+    # violate, a ceiling of 1e12 MUST hold.
+    violating_spec = os.path.join(workdir, "slo_violating.json")
+    passing_spec = os.path.join(workdir, "slo_passing.json")
+    for path, objective in ((violating_spec, 1e-30), (passing_spec, 1e12)):
+        with open(path, "w") as f:
+            json.dump({"version": 1, "slos": [
+                {"name": "seeded_grad_ceiling", "kind": "gauge_max",
+                 "metric": "health/grad_norm", "objective": objective},
+            ]}, f)
     # strict=True: the run-wide D2H guard + per-wave full transfer guard
     # stay green with the obs instrumentation active (the self-gate half
     # of the acceptance criteria; rocketlint covers the static half).
     # health=True: the sentinel-instrumented step path — health word
     # computed in-jit, fetched lagged+explicit — must ALSO stay sync-free
     # under the guards.
+    # export=True + metrics_port=0: the live plane (shards, /metrics,
+    # online SLO evaluation) runs the whole time — under the same strict
+    # guards, proving exporting adds zero device syncs to the step path.
     runtime = rt.Runtime(
         mesh_shape={"data": 8}, seed=0, project_dir=workdir,
         strict=True, telemetry=True, watchdog_secs=120.0,
         health=True, anomaly_action="skip_step",
+        export=True, export_interval_s=0.2, metrics_port=0,
+        slo=violating_spec,
     )
+    exporter = runtime.telemetry.exporter
+    check(exporter is not None and exporter.server is not None,
+          "export=True + metrics_port=0 did not mount the live plane")
+    metrics_url = f"http://127.0.0.1:{exporter.server.port}/metrics"
+    # The endpoint lives exactly as long as the run (end_training stops
+    # it), so the scrape must happen MID-RUN: poll from a thread, keep
+    # the last successful body.
+    scrape = {"body": "", "n": 0}
+    scraping = threading.Event()
+
+    def _poll():
+        while not scraping.wait(0.1):
+            try:
+                with urllib.request.urlopen(metrics_url, timeout=2) as resp:
+                    scrape["body"] = resp.read().decode()
+                    scrape["n"] += 1
+            except OSError:
+                pass
+
+    poller = threading.Thread(target=_poll, daemon=True)
+    poller.start()
     model = MLP(in_features=8, num_classes=4, hidden=(16,))
     module = rt.Module(
         model,
@@ -113,6 +163,9 @@ def main() -> None:
         num_epochs=2,
         runtime=runtime,
     ).launch()
+
+    scraping.set()
+    poller.join(timeout=5)
 
     out_dir = os.path.join(runs_dir, "smoke")
     telemetry_path = os.path.join(out_dir, "telemetry.json")
@@ -223,13 +276,75 @@ def main() -> None:
           f"analysis calib gate failed: {proc.stdout[-300:]} "
           f"{proc.stderr[-300:]}")
 
+    # -- live export: shards, /metrics, SLO gates (ISSUE 19) ---------------
+    # Mid-run scrape: the poller caught at least one /metrics body, and
+    # it carries the train families a Prometheus server would ingest.
+    check(scrape["n"] > 0, "no successful mid-run /metrics scrape")
+    for family in ("rocket_tpu_goodput_goodput_fraction",
+                   "rocket_tpu_perf_steps_per_sec",
+                   "rocket_tpu_obs_slo_seeded_grad_ceiling_burn_rate"):
+        check(family in scrape["body"],
+              f"{family} missing from the mid-run scrape")
+    check('rank="0"' in scrape["body"], "scrape samples carry no rank label")
+
+    # Streaming shard: one continuous per-rank history next to
+    # telemetry.json (the early default-dir records migrated along when
+    # the Tracker resolved runs/smoke), final record flagged.
+    shard_path = os.path.join(out_dir, "telemetry", "rank0.jsonl")
+    check(os.path.exists(shard_path), f"{shard_path} not written")
+    with open(shard_path) as f:
+        shard = [json.loads(line) for line in f if line.strip()]
+    check(len(shard) >= 2, f"only {len(shard)} shard record(s)")
+    check(shard[-1]["final"], "no final=True shard record at teardown")
+    check(shard[-1]["seq"] == len(shard) - 1,
+          "shard seq not contiguous — split or clobbered history")
+    check(shard[-1]["hostname"] and shard[-1]["rank"] == 0,
+          "shard records missing process identity")
+
+    # Online detection: the seeded violation fired DURING the run — the
+    # edge counter landed in the registry snapshot telemetry.json keeps.
+    counters = record["metrics"]["counters"]
+    check(counters.get("obs/slo/seeded_grad_ceiling/violations", 0) >= 1,
+          "seeded SLO violation not detected online")
+    check(gauges.get("obs/slo/seeded_grad_ceiling/violated") == 1.0,
+          "obs/slo/*/violated gauge not set")
+
+    # Offline gates over the same shards: violating spec -> exit 1 with
+    # a VIOLATION line; slack spec -> exit 0; fleet view renders.
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "watch", out_dir,
+         "--slo", violating_spec],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 1,
+          f"obs watch on the seeded violation exited {proc.returncode} "
+          f"(want 1): {proc.stderr[-300:]}")
+    check("VIOLATION seeded_grad_ceiling" in proc.stdout,
+          "obs watch printed no VIOLATION line")
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "watch", out_dir,
+         "--slo", passing_spec],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 0,
+          f"obs watch on the slack spec exited {proc.returncode} (want 0)")
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "top", out_dir, "--once"],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 0,
+          f"obs top --once failed: {proc.stderr[-300:]}")
+    check("1 rank(s)" in proc.stdout, "obs top did not render the fleet")
+
     print(
         "obs smoke OK: "
         f"goodput step={goodput['fractions']['step']:.1%} "
         f"compile={goodput['fractions']['compile']:.1%}, "
         f"{len(complete)} spans, health sentinels green "
         f"(last good step {health['last_good_step']}), strict guards "
-        "green, capture->parse->reconcile leg green"
+        "green, capture->parse->reconcile leg green, live export green "
+        f"({scrape['n']} mid-run scrapes, {len(shard)} shard records, "
+        "seeded SLO gate fired)"
     )
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Serve smoke gate: the continuous-batching engine end to end on CPU.
 
-Three legs (wired into scripts/check.sh and CI):
+Four legs (wired into scripts/check.sh and CI):
 
 1. **In-process**: a 50-request synthetic workload on a tiny LM through
    :class:`rocket_tpu.serve.ServeEngine` must (a) complete every request,
@@ -10,13 +10,18 @@ Three legs (wired into scripts/check.sh and CI):
    obs registry gauges, (c) produce greedy outputs token-identical to
    ``generate()`` for sampled spot-checks, and (d) leave a telemetry.json
    whose serve gauges + per-request spans tell the same story.
-2. **Scanned waves** (ISSUE 11): the same model served with
+2. **Live export** (ISSUE 19): a serving session with the live plane
+   armed must expose a mid-serve ``/metrics`` endpoint carrying the
+   serve families, stream telemetry shards, detect a seeded ITL-p99 SLO
+   violation online (``obs/slo/*`` counter), and gate ``python -m
+   rocket_tpu.obs watch --slo`` offline (exit 1 seeded / 0 slack).
+3. **Scanned waves** (ISSUE 11): the same model served with
    ``decode_waves_per_dispatch=4`` must produce greedy outputs
    BIT-IDENTICAL to the k=1 engine for an identical workload, with zero
    retraces, exactly ONE ``jax.device_get`` per dispatch of k waves
    (the tunnel amortization the k-wave ``lax.scan`` exists for), and a
    measured tokens-per-dispatch meaningfully above 1.
-3. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess (with a
+4. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess (with a
    k-wave flag) must stream output, print the serve report, exit 0, and
    the ``report`` subcommand must render its telemetry.
 
@@ -131,6 +136,109 @@ def engine_leg(out_dir: str) -> None:
           f"tok/s={report['tokens_per_sec']:.0f})")
 
 
+def export_leg(out_dir: str) -> None:
+    """Live plane over a serving session (ISSUE 19): /metrics scrapeable
+    mid-serve with the serve families, shards streamed, and a seeded
+    ITL-p99 SLO violation (objective 1 ps — any real inter-token gap
+    violates) detected online and gating ``obs watch`` offline.
+
+    The seeded spec, not default:serve, keeps the verdict deterministic:
+    the committed serve objectives are TPU roofline ceilings a CPU toy
+    run sits nowhere near."""
+    import urllib.request
+
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    from rocket_tpu.obs.export import ExportConfig
+    from rocket_tpu.obs.telemetry import Telemetry
+    from rocket_tpu.serve import ServeConfig, ServeEngine
+
+    violating = os.path.join(out_dir, "slo_itl_tight.json")
+    passing = os.path.join(out_dir, "slo_itl_slack.json")
+    os.makedirs(out_dir, exist_ok=True)
+    for path, objective in ((violating, 1e-12), (passing, 3600.0)):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "slos": [
+                {"name": "seeded_itl_p99", "kind": "quantile",
+                 "metric": "serve/itl_s", "quantile": 0.99,
+                 "objective": objective},
+            ]}, f)
+
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(0))
+    telemetry = Telemetry(enabled=True, out_dir=out_dir)
+    telemetry.start()
+    telemetry.start_export(
+        ExportConfig(enabled=True, interval_s=0.2, metrics_port=0,
+                     slo_path=violating),
+        default_dir=out_dir,
+    )
+    exporter = telemetry.exporter
+    check(exporter is not None and exporter.server is not None,
+          "export config did not mount the live plane")
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=8, prefill_chunk=8,
+                    max_model_len=48),
+        telemetry=telemetry,
+    )
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        prompt = rng.integers(0, 64, size=int(rng.integers(1, 10)))
+        engine.submit(prompt.astype(np.int32), max_new_tokens=6,
+                      temperature=0.0)
+    engine.drain()
+    # One deterministic tick (the thread also ticks at 0.2s cadence):
+    # the seeded quantile SLO sees the serve/itl_s histogram and fires.
+    record = exporter.tick()
+    verdict, = [s for s in record["slo"] if s["name"] == "seeded_itl_p99"]
+    check(verdict["violated"],
+          f"seeded ITL SLO not violated online: {verdict}")
+    counters = telemetry.registry.snapshot()["counters"]
+    check(counters.get("obs/slo/seeded_itl_p99/violations", 0) >= 1,
+          "online violation did not land the obs/slo/* edge counter")
+
+    # Mid-serve scrape: the serve families a Prometheus server would
+    # ingest, with cumulative buckets and the rank label.
+    url = f"http://127.0.0.1:{exporter.server.port}/metrics"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        body = resp.read().decode()
+    for family in ("rocket_tpu_serve_ttft_s_bucket",
+                   "rocket_tpu_serve_itl_s_count",
+                   "rocket_tpu_serve_slots_active",
+                   "rocket_tpu_obs_slo_seeded_itl_p99_burn_rate"):
+        check(family in body, f"{family} missing from the /metrics scrape")
+    check('le="+Inf"' in body, "no +Inf closing bucket in the exposition")
+
+    telemetry.close(write=False)
+    shard_path = os.path.join(out_dir, "telemetry", "rank0.jsonl")
+    check(os.path.exists(shard_path), f"{shard_path} not written")
+
+    # Offline gates over the shards this session just streamed.
+    watch = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "watch", out_dir,
+         "--slo", violating],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    check(watch.returncode == 1,
+          f"obs watch on the seeded ITL violation exited {watch.returncode} "
+          f"(want 1): {watch.stderr[-300:]}")
+    check("VIOLATION seeded_itl_p99" in watch.stdout,
+          "obs watch printed no VIOLATION line")
+    watch = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "watch", out_dir,
+         "--slo", passing],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    check(watch.returncode == 0,
+          f"obs watch on the slack spec exited {watch.returncode} (want 0)")
+    print("serve smoke: export leg OK (mid-serve /metrics scrape, "
+          "seeded ITL-p99 SLO fired online + gated offline)")
+
+
 def scan_leg() -> None:
     """k-wave scanned dispatch: greedy parity with k=1, one device_get
     per k waves, zero retraces."""
@@ -227,6 +335,7 @@ def main() -> None:
 
     workdir = tempfile.mkdtemp(prefix="serve_smoke_", dir=repo_runs)
     engine_leg(os.path.join(workdir, "engine"))
+    export_leg(os.path.join(workdir, "export"))
     scan_leg()
     cli_leg(os.path.join(workdir, "cli"))
     print("serve smoke: all checks passed")

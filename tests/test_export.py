@@ -1,0 +1,531 @@
+"""rocket_tpu.obs.export + obs.slo — the live telemetry plane:
+streaming JSONL shards, Prometheus text exposition, the /metrics
+endpoint, cross-rank merge math, SLO burn-rate gates, and the obs
+CLI's live subcommands (top / watch / report shard-fallback).
+
+Deliberately jax-free: the whole plane is stdlib + registry dicts,
+and these tests pin that (the supervisor imports it signal-safe)."""
+
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rocket_tpu.obs.export import (
+    ExportConfig,
+    PrometheusServer,
+    ShardWriter,
+    TelemetryExporter,
+    host_identity,
+    merge_rank_records,
+    prometheus_name,
+    read_shard_file,
+    read_telemetry_dir,
+    render_prometheus,
+)
+from rocket_tpu.obs.registry import MetricsRegistry, estimate_quantiles
+from rocket_tpu.obs.slo import SLOEvaluator, SLOSpec, load_slo_specs
+from rocket_tpu.obs.telemetry import Telemetry
+
+
+def parse_prometheus(text: str) -> dict:
+    """A deliberately tiny text-exposition (0.0.4) parser: enough of the
+    grammar to verify what a real scraper would ingest. Returns
+    ``{metric: {"type": kind, "samples": [(labels_dict, value)]}}`` where
+    samples are keyed by the FULL sample name (incl. _bucket/_sum/_count)."""
+    families: dict = {}
+    samples: dict = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            families[name] = kind
+        elif line and not line.startswith("#"):
+            name_labels, raw = line.rsplit(" ", 1)
+            labels = {}
+            if "{" in name_labels:
+                name, inner = name_labels.split("{", 1)
+                for pair in inner.rstrip("}").split(","):
+                    key, val = pair.split("=", 1)
+                    labels[key] = val.strip('"')
+            else:
+                name = name_labels
+            value = float(raw)
+            samples.setdefault(name, []).append((labels, value))
+    return {"types": families, "samples": samples}
+
+
+# -- streaming shards ------------------------------------------------------
+
+
+def test_shard_round_trip_skips_torn_last_line(tmp_path):
+    """One complete JSON object per line; a crash mid-append tears at
+    most the final line, which every reader skips — the shard's
+    crash-readability contract."""
+    path = str(tmp_path / "telemetry" / "rank0.jsonl")
+    writer = ShardWriter(path)
+    for seq in range(3):
+        writer.append({"version": 1, "seq": seq, "rank": 0,
+                       "metrics": {"gauges": {"perf/steps_per_sec": 40 + seq}}})
+    # Simulate the crash: a torn, undecodable trailing line.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"version": 1, "seq": 3, "metr')
+    records = read_shard_file(path)
+    assert [r["seq"] for r in records] == [0, 1, 2]
+    assert records[-1]["metrics"]["gauges"]["perf/steps_per_sec"] == 42
+    # A fresh writer resumes the line count instead of clobbering.
+    resumed = ShardWriter(path)
+    resumed.append({"version": 1, "seq": 4})
+    assert [r["seq"] for r in read_shard_file(path)] == [0, 1, 2, 4]
+
+
+def test_shard_compaction_bounds_and_keeps_newest(tmp_path):
+    path = str(tmp_path / "rank0.jsonl")
+    writer = ShardWriter(path, retention_lines=10)
+    for seq in range(25):
+        writer.append({"seq": seq})
+    records = read_shard_file(path)
+    assert len(records) <= 10
+    # Newest records survive compaction; no temp file left behind.
+    assert records[-1]["seq"] == 24
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_read_telemetry_dir_groups_by_rank(tmp_path):
+    run = tmp_path / "run"
+    for rank in (0, 2):
+        ShardWriter(str(run / "telemetry" / f"rank{rank}.jsonl")).append(
+            {"seq": 0, "rank": rank}
+        )
+    # Non-shard files are ignored.
+    (run / "telemetry" / "notes.txt").write_text("hi")
+    shards = read_telemetry_dir(str(run))
+    assert sorted(shards) == [0, 2]
+    # Resolving the telemetry dir itself works too.
+    assert sorted(read_telemetry_dir(str(run / "telemetry"))) == [0, 2]
+    assert read_telemetry_dir(str(tmp_path / "empty")) == {}
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+
+def test_prometheus_name_mangling():
+    assert prometheus_name("serve/ttft_s") == "rocket_tpu_serve_ttft_s"
+    assert prometheus_name("obs/slo/x-y.z/burn_rate") == \
+        "rocket_tpu_obs_slo_x_y_z_burn_rate"
+
+
+def test_render_prometheus_buckets_cumulative_and_inf_closes():
+    """The registry stores per-bucket counts; the exposition must be
+    cumulative, closed by a mandatory +Inf bucket equal to _count."""
+    registry = MetricsRegistry()
+    registry.counter("serve/requests").inc(7)
+    registry.gauge("goodput/goodput_fraction").set(0.85)
+    hist = registry.histogram("serve/itl_s", base=1e-6)
+    for value in (1e-6, 3e-6, 3e-6, 100e-6, 0.1):
+        hist.observe(value)
+    parsed = parse_prometheus(
+        render_prometheus(registry.snapshot(), labels={"rank": 1})
+    )
+    assert parsed["types"]["rocket_tpu_serve_requests"] == "counter"
+    assert parsed["types"]["rocket_tpu_goodput_goodput_fraction"] == "gauge"
+    assert parsed["types"]["rocket_tpu_serve_itl_s"] == "histogram"
+    (labels, value), = parsed["samples"]["rocket_tpu_serve_requests"]
+    assert labels == {"rank": "1"} and value == 7.0
+    buckets = parsed["samples"]["rocket_tpu_serve_itl_s_bucket"]
+    # Cumulative: monotone non-decreasing in le order, +Inf last == count.
+    ordered = sorted(buckets, key=lambda s: float(
+        s[0]["le"].replace("+Inf", "inf")))
+    counts = [value for _, value in ordered]
+    assert counts == sorted(counts)
+    assert ordered[-1][0]["le"] == "+Inf" and ordered[-1][1] == 5.0
+    (_, count), = parsed["samples"]["rocket_tpu_serve_itl_s_count"]
+    assert count == 5.0
+    (_, total), = parsed["samples"]["rocket_tpu_serve_itl_s_sum"]
+    assert total == pytest.approx(1e-6 + 3e-6 + 3e-6 + 100e-6 + 0.1)
+
+
+def test_metrics_endpoint_serves_live_snapshots(tmp_path):
+    """port=0 binds ephemeral; every scrape re-reads the registry (the
+    second GET sees the gauge move); non-/metrics paths 404."""
+    registry = MetricsRegistry()
+    registry.gauge("train/step").set(1)
+    server = PrometheusServer(registry.snapshot, port=0,
+                              labels={"rank": 0})
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'rocket_tpu_train_step{rank="0"} 1' in body
+        registry.gauge("train/step").set(2)
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'rocket_tpu_train_step{rank="0"} 2' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=5
+            )
+    finally:
+        server.stop()
+
+
+# -- configuration ---------------------------------------------------------
+
+
+def test_export_config_from_env(monkeypatch):
+    monkeypatch.delenv("ROCKET_TPU_EXPORT", raising=False)
+    monkeypatch.delenv("ROCKET_TPU_METRICS_PORT", raising=False)
+    monkeypatch.delenv("ROCKET_TPU_SLO", raising=False)
+    assert not ExportConfig.from_env().active
+    # Numeric ROCKET_TPU_EXPORT enables AND sets the tick interval.
+    monkeypatch.setenv("ROCKET_TPU_EXPORT", "2.5")
+    config = ExportConfig.from_env()
+    assert config.enabled and config.interval_s == 2.5
+    # A bare truthy flag keeps the default cadence.
+    monkeypatch.setenv("ROCKET_TPU_EXPORT", "1")
+    assert ExportConfig.from_env().interval_s == 10.0
+    monkeypatch.setenv("ROCKET_TPU_METRICS_PORT", "9099")
+    monkeypatch.setenv("ROCKET_TPU_SLO", "default:train")
+    config = ExportConfig.from_env()
+    assert config.metrics_port == 9099 and config.slo_path == "default:train"
+    # Explicit arguments win over the environment.
+    config = ExportConfig.from_env(enabled=False, metrics_port=7)
+    assert not config.enabled and config.metrics_port == 7 and config.active
+
+
+def test_host_identity_reads_launcher_env(monkeypatch):
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    identity = host_identity()
+    assert identity["rank"] == 3
+    assert identity["hostname"] and identity["pid"] == os.getpid()
+    assert host_identity(process_index=5)["rank"] == 5
+
+
+# -- SLO burn rates --------------------------------------------------------
+
+
+def test_slo_gauge_min_burn_and_warmup_grace():
+    """goodput_fraction 0.0 at t=0 is a cold start, not an incident:
+    warmup_s suppresses the violation while still reporting the burn.
+    Past warmup the same burn violates, once (newly_violated edge)."""
+    spec = SLOSpec(name="train_goodput", kind="gauge_min",
+                   metric="goodput/goodput_fraction", objective=0.8,
+                   warmup_s=30.0)
+    evaluator = SLOEvaluator([spec])
+    status, = evaluator.observe(
+        0.0, {"gauges": {}}, {"goodput_fraction": 0.0})
+    assert status.burn_rate == math.inf and not status.violated
+    status, = evaluator.observe(
+        10.0, {"gauges": {}}, {"goodput_fraction": 0.4})
+    assert status.burn_rate == pytest.approx(2.0)
+    assert not status.violated  # still inside warmup
+    status, = evaluator.observe(
+        60.0, {"gauges": {}}, {"goodput_fraction": 0.4})
+    assert status.violated and status.newly_violated
+    status, = evaluator.observe(
+        70.0, {"gauges": {}}, {"goodput_fraction": 0.4})
+    assert status.violated and not status.newly_violated
+    # Recovery clears the latch; the next violation is "new" again.
+    status, = evaluator.observe(
+        80.0, {"gauges": {}}, {"goodput_fraction": 0.95})
+    assert not status.violated and status.burn_rate < 1.0
+
+
+def test_slo_gauge_max_burn():
+    spec = SLOSpec(name="queue", kind="gauge_max",
+                   metric="serve/queue_depth", objective=64.0)
+    evaluator = SLOEvaluator([spec])
+    status, = evaluator.observe(0.0, {"gauges": {"serve/queue_depth": 16.0}})
+    assert status.burn_rate == pytest.approx(0.25) and not status.violated
+    status, = evaluator.observe(1.0, {"gauges": {"serve/queue_depth": 128.0}})
+    assert status.burn_rate == pytest.approx(2.0) and status.newly_violated
+    # No data at all: burn 0, value None, no violation.
+    status, = evaluator.observe(2.0, {"gauges": {}})
+    assert status.value is None and status.burn_rate == 0.0
+
+
+def test_slo_quantile_burn_true_positive_and_negative():
+    """Quantile burn = bad_fraction / (1 - q) over windowed bucket
+    deltas: a tail above the ceiling violates, an all-fast window does
+    not, and the windowing ages the cold-start tail out."""
+    spec = SLOSpec(name="itl_p99", kind="quantile", metric="serve/itl_s",
+                   objective=1e-3, quantile=0.9, window_s=100.0)
+    registry = MetricsRegistry()
+    hist = registry.histogram("serve/itl_s", base=1e-6)
+    evaluator = SLOEvaluator([spec])
+    # Negative: 50 observations all well under the 1ms ceiling.
+    for _ in range(50):
+        hist.observe(1e-4)
+    status, = evaluator.observe(0.0, registry.snapshot())
+    assert not status.violated and status.burn_rate == 0.0
+    assert status.value == pytest.approx(1e-4, rel=1.0)
+    # True positive: half the next window sits 100x over the ceiling.
+    for _ in range(50):
+        hist.observe(1e-1)
+    status, = evaluator.observe(10.0, registry.snapshot())
+    assert status.violated
+    assert status.burn_rate >= 1.0  # bad fraction ~0.5 vs budget 0.1
+    # Window slide: a quiet period after the spike evaluates only the
+    # (empty) delta — no data, no violation, the tail aged out.
+    status, = evaluator.observe(200.0, registry.snapshot())
+    assert status.value is None and not status.violated
+
+
+def test_load_slo_specs_defaults_and_validation(tmp_path):
+    serve = load_slo_specs("default:serve")
+    train = load_slo_specs("default:train")
+    assert {s.name for s in serve} >= {"serve_itl_p99", "serve_ttft_p99"}
+    assert {s.name for s in train} >= {"train_goodput",
+                                       "train_steps_per_sec"}
+    # The budget-derived objectives resolved to real finite ceilings.
+    for spec in serve:
+        assert math.isfinite(spec.objective) and spec.objective > 0
+    # Train specs carry the cold-start grace.
+    assert all(s.warmup_s > 0 for s in train)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"version": 1, "slos": [
+        {"name": "x", "kind": "nope", "metric": "m", "objective": 1}
+    ]}))
+    with pytest.raises(ValueError):
+        load_slo_specs(str(bad))
+    with pytest.raises(ValueError):
+        load_slo_specs("default:imaginary")
+
+
+# -- cross-rank merge ------------------------------------------------------
+
+
+def _rank_record(rank: int, steps_per_sec: float, requests: float,
+                 itl_buckets: dict) -> dict:
+    return {
+        "rank": rank, "seq": 5, "t_unix": 1000.0, "uptime_s": 50.0,
+        "hostname": f"host{rank}", "pid": 100 + rank,
+        "goodput": {"goodput_fraction": 0.9},
+        "metrics": {
+            "counters": {"serve/requests": requests},
+            "gauges": {"perf/steps_per_sec": steps_per_sec},
+            "histograms": {"serve/itl_s": {
+                "count": sum(itl_buckets.values()),
+                "total": 1.0, "min": 1e-5, "max": 1e-2,
+                "buckets": itl_buckets,
+            }},
+        },
+    }
+
+
+def test_merge_rank_records_math():
+    latest = {
+        0: _rank_record(0, 50.0, 100.0, {"le_1e-05": 10, "le_2e-05": 30}),
+        1: _rank_record(1, 40.0, 120.0, {"le_2e-05": 10, "le_4e-05": 50}),
+        2: _rank_record(2, 10.0, 80.0, {"le_1e-05": 5}),
+    }
+    merged = merge_rank_records(latest)
+    assert merged["ranks"] == [0, 1, 2]
+    # Counters: fleet total is the per-process sum.
+    assert merged["counters"]["serve/requests"] == pytest.approx(300.0)
+    # Gauges: spread stats with arg-min/arg-max rank attribution.
+    stat = merged["gauges"]["perf/steps_per_sec"]
+    assert stat["mean"] == pytest.approx(100.0 / 3)
+    assert stat["min"] == 10.0 and stat["min_rank"] == 2
+    assert stat["max"] == 50.0 and stat["max_rank"] == 0
+    assert stat["skew"] == pytest.approx((50.0 - 10.0) / (100.0 / 3))
+    # Histograms: buckets summed, quantile estimation works on the merge.
+    hist = merged["histograms"]["serve/itl_s"]
+    assert hist["count"] == 105
+    assert hist["buckets"] == {"le_1e-05": 15, "le_2e-05": 40,
+                               "le_4e-05": 50}
+    assert hist["min"] == 1e-5 and hist["max"] == 1e-2
+    quantiles = estimate_quantiles(hist)
+    assert 1e-5 <= quantiles["p50"] <= 4e-5
+
+
+def test_merge_uniform_fleet_has_zero_skew():
+    latest = {r: _rank_record(r, 42.0, 1.0, {"le_1e-05": 1})
+              for r in range(4)}
+    stat = merge_rank_records(latest)["gauges"]["perf/steps_per_sec"]
+    assert stat["skew"] == 0.0 and stat["mean"] == 42.0
+
+
+# -- the exporter ----------------------------------------------------------
+
+
+def test_exporter_tick_shard_schema_and_slo_gauges(tmp_path):
+    """One synchronous tick: the shard record carries schema version,
+    identity, goodput and the registry snapshot; a violated SLO becomes
+    obs/slo/* gauges + a violation counter inside the same record."""
+    spec_file = tmp_path / "slo.json"
+    spec_file.write_text(json.dumps({"version": 1, "slos": [
+        {"name": "steps_floor", "kind": "gauge_min",
+         "metric": "perf/steps_per_sec", "objective": 100.0},
+    ]}))
+    telemetry = Telemetry(enabled=True, out_dir=str(tmp_path / "run"))
+    telemetry.registry.gauge("perf/steps_per_sec").set(5.0)
+    exporter = TelemetryExporter(
+        telemetry,
+        ExportConfig(enabled=True, slo_path=str(spec_file)),
+        identity={"rank": 0, "hostname": "testhost", "pid": 1234},
+    )
+    record = exporter.tick()
+    assert record["version"] == 1 and record["seq"] == 0
+    assert record["rank"] == 0 and record["hostname"] == "testhost"
+    assert not record["final"]
+    assert record["goodput"]["goodput_fraction"] is not None
+    # The SLO verdict rides the record AND the registry.
+    verdict, = [s for s in record["slo"] if s["name"] == "steps_floor"]
+    assert verdict["violated"] and verdict["burn_rate"] == pytest.approx(20.0)
+    gauges = record["metrics"]["gauges"]
+    assert gauges["obs/slo/steps_floor/violated"] == 1.0
+    assert record["metrics"]["counters"][
+        "obs/slo/steps_floor/violations"] == 1
+    # On disk: one parseable line under <out_dir>/telemetry/rank0.jsonl.
+    shard = tmp_path / "run" / "telemetry" / "rank0.jsonl"
+    assert read_shard_file(str(shard))[0]["seq"] == 0
+    final = exporter.tick(final=True)
+    assert final["final"] and final["seq"] == 1
+    # Sustained violation: the edge counter did not move again.
+    assert final["metrics"]["counters"][
+        "obs/slo/steps_floor/violations"] == 1
+
+
+def test_exporter_migrates_shard_when_out_dir_resolves_late(tmp_path):
+    """A Tracker suggesting runs/<project> after the first ticks must
+    not split the shard history — the exporter carries the early file
+    to the new path (os.replace) and appends there."""
+    telemetry = Telemetry(enabled=True)
+    exporter = TelemetryExporter(
+        telemetry, ExportConfig(enabled=True),
+        identity={"rank": 0, "hostname": "h", "pid": 1},
+        default_dir=str(tmp_path / "early"),
+    )
+    exporter.tick()
+    old = tmp_path / "early" / "telemetry" / "rank0.jsonl"
+    assert old.exists()
+    telemetry.suggest_out_dir(str(tmp_path / "runs" / "proj"))
+    exporter.tick()
+    new = tmp_path / "runs" / "proj" / "telemetry" / "rank0.jsonl"
+    assert not old.exists(), "split shard history left behind"
+    assert [r["seq"] for r in read_shard_file(str(new))] == [0, 1]
+
+
+# -- identity in forensic surfaces ----------------------------------------
+
+
+def test_watchdog_report_carries_identity():
+    from rocket_tpu.obs.watchdog import Watchdog
+
+    watchdog = Watchdog(deadline_s=60.0)
+    watchdog.identity = {"rank": 2, "hostname": "tpu-worker-2", "pid": 99}
+    report = watchdog._build_report(stalled_for=120.0)
+    assert "process: rank 2 on tpu-worker-2 (pid 99)" in report
+
+
+def test_flight_manifest_carries_rank_and_hostname(tmp_path):
+    from rocket_tpu.obs.flight import FlightRecorder
+
+    class _StubRuntime:
+        process_index = 1
+        process_count = 4
+        is_main_process = True
+        project_dir = str(tmp_path)
+
+        def rng_state_dict(self):
+            return {}
+
+    telemetry = Telemetry(enabled=True, out_dir=str(tmp_path / "run"))
+    recorder = FlightRecorder(telemetry=telemetry, runtime=_StubRuntime())
+    bundle = recorder.dump("unit_test")
+    assert bundle is not None
+    with open(os.path.join(bundle, "blackbox.json"), encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["process"]["rank"] == 1
+    assert manifest["process"]["hostname"]
+    assert manifest["process"]["count"] == 4
+
+
+def test_supervisor_metrics_endpoint(tmp_path):
+    """The supervisor mounts its own /metrics (role="supervisor" label)
+    so a restarting fleet keeps one stable scrape target — stdlib-only,
+    no backend init (the supervisor must stay signal-safe)."""
+    from rocket_tpu.resilience.supervisor import Supervisor
+
+    supervisor = Supervisor(nproc=2, script="train.py", metrics_port=0,
+                            state_dir=str(tmp_path))
+    supervisor._start_metrics()
+    try:
+        assert supervisor._metrics_server is not None
+        supervisor._publish_metrics()
+        url = f"http://127.0.0.1:{supervisor._metrics_server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+    finally:
+        supervisor._stop_metrics()
+    assert 'rocket_tpu_supervisor_restarts{role="supervisor"} 0' in body
+    assert 'rocket_tpu_supervisor_generations{role="supervisor"} 0' in body
+    assert "rocket_tpu_supervisor_goodput_fraction" in body
+
+
+# -- the obs CLI: top / watch / report fallback ----------------------------
+
+
+def _write_fleet(run_dir, ranks=(0, 1)) -> None:
+    for rank in ranks:
+        ShardWriter(
+            os.path.join(run_dir, "telemetry", f"rank{rank}.jsonl")
+        ).append(_rank_record(rank, 50.0 - 10 * rank, 100.0,
+                              {"le_1e-05": 10}))
+
+
+def test_obs_top_once_renders_fleet(tmp_path, capsys):
+    from rocket_tpu.obs.__main__ import main
+
+    _write_fleet(str(tmp_path))
+    assert main(["top", str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "obs top — 2 rank(s)" in out
+    assert "host0" in out and "host1" in out
+    assert "perf/steps_per_sec" in out
+    assert "rank 0" in out  # slowest-rank attribution column
+    assert "serve/itl_s" in out
+    # No shards at all: usage error, stderr hint.
+    assert main(["top", str(tmp_path / "void"), "--once"]) == 2
+
+
+def test_obs_watch_gates_on_slo(tmp_path, capsys):
+    from rocket_tpu.obs.__main__ import main
+
+    _write_fleet(str(tmp_path))
+    violating = tmp_path / "tight.json"
+    violating.write_text(json.dumps({"version": 1, "slos": [
+        {"name": "steps_floor", "kind": "gauge_min",
+         "metric": "perf/steps_per_sec", "objective": 1000.0},
+    ]}))
+    passing = tmp_path / "slack.json"
+    passing.write_text(json.dumps({"version": 1, "slos": [
+        {"name": "steps_floor", "kind": "gauge_min",
+         "metric": "perf/steps_per_sec", "objective": 1.0},
+    ]}))
+    assert main(["watch", str(tmp_path), "--slo", str(violating)]) == 1
+    out = capsys.readouterr().out
+    assert "VIOLATION steps_floor (rank 0)" in out
+    assert "VIOLATION steps_floor (rank 1)" in out
+    assert main(["watch", str(tmp_path), "--slo", str(passing)]) == 0
+    assert "all SLOs within objective" in capsys.readouterr().out
+    assert main(["watch", str(tmp_path), "--slo",
+                 str(tmp_path / "missing.json")]) == 2
+
+
+def test_obs_report_falls_back_to_shards(tmp_path, capsys):
+    """A run dir with no telemetry.json (worker died before DESTROY)
+    still reports from its streaming shards."""
+    from rocket_tpu.obs.__main__ import main
+
+    solo = tmp_path / "solo"
+    _write_fleet(str(solo), ranks=(0,))
+    assert main(["report", str(solo)]) == 0
+    out = capsys.readouterr().out
+    assert "reconstructed from streaming shards" in out
+    fleet = tmp_path / "fleet"
+    _write_fleet(str(fleet), ranks=(0, 1))
+    assert main(["report", str(fleet)]) == 0
+    assert "obs top — 2 rank(s)" in capsys.readouterr().out
+    assert main(["report", str(tmp_path / "void")]) == 2

@@ -630,3 +630,44 @@ def test_sampling_core_array_scalar_parity():
     out, done2 = freeze_after_eos(nxt, done, eos)
     np.testing.assert_array_equal(np.asarray(out), [5, 0, 7])
     np.testing.assert_array_equal(np.asarray(done2), [True, True, False])
+
+
+def test_reset_metrics_windows_registry_histograms(tiny_lm):
+    """reset_metrics() windows the registry-side serve histograms too:
+    the /metrics endpoint and telemetry.json percentiles must describe
+    the same steady-state window the report does, while the lifetime
+    trace-count gauges (the no-retrace proof) survive the reset."""
+    from rocket_tpu.obs.telemetry import Telemetry
+
+    model, variables = tiny_lm
+    telemetry = Telemetry(enabled=True)
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=32),
+        telemetry=telemetry,
+    )
+    for seed in range(4):
+        prompt = np.arange(1, 5, dtype=np.int32) + seed
+        engine.submit(prompt, max_new_tokens=4, temperature=0.0)
+    engine.drain()
+
+    hists = telemetry.registry.snapshot()["histograms"]
+    assert hists["serve/ttft_s"]["count"] == 4
+    assert hists["serve/itl_s"]["count"] > 0
+
+    engine.reset_metrics()
+    snap = telemetry.registry.snapshot()
+    assert snap["histograms"]["serve/ttft_s"]["count"] == 0
+    assert snap["histograms"]["serve/ttft_s"]["buckets"] == {}
+    assert snap["histograms"]["serve/itl_s"]["count"] == 0
+    # Lifetime gauges are NOT windowed: still the compiled-once proof.
+    assert snap["gauges"]["serve/decode_traces"] == 1
+    assert snap["gauges"]["serve/prefill_traces"] == 1
+
+    # Steady state re-accumulates into the fresh window.
+    engine.submit(np.asarray([3, 1, 2], np.int32), max_new_tokens=3,
+                  temperature=0.0)
+    engine.drain()
+    hists = telemetry.registry.snapshot()["histograms"]
+    assert hists["serve/ttft_s"]["count"] == 1
