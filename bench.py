@@ -1434,6 +1434,11 @@ def serve_summary(requests=64, warmup_requests=8):
             "kv_pool_mib": round(
                 report["pool"]["kv_pool_bytes"] / 2**20, 1
             ),
+            # Request-phase attribution (obs.reqtrace): where retained
+            # requests' wall time went + ITL-gap split. The overhead
+            # contract (tokens/sec with tracing on ≈ off) is gated in
+            # scripts/serve_smoke.py; the bench just publishes phases.
+            "phases": report["phases"],
         }
     except Exception as exc:  # noqa: BLE001 — best-effort, like the audits
         log(f"bench: serve_summary failed: {exc!r}")

@@ -376,6 +376,25 @@ def _latest_per_rank(path: str) -> dict[int, dict]:
     }
 
 
+def _slo_rows(latest: dict[int, dict]) -> list[tuple]:
+    """``(slo_name, rank, burn_rate, violated)`` rows from the
+    ``obs/slo/<name>/burn_rate`` + ``/violated`` gauges the live
+    exporter writes into each rank's shard — already in the shards,
+    top just renders them."""
+    rows = []
+    for rank in sorted(latest):
+        gauges = (latest[rank].get("metrics") or {}).get("gauges") or {}
+        for name, value in sorted(gauges.items()):
+            if not (name.startswith("obs/slo/")
+                    and name.endswith("/burn_rate")):
+                continue
+            slo = name[len("obs/slo/"):-len("/burn_rate")]
+            violated = bool(gauges.get(f"obs/slo/{slo}/violated", 0.0))
+            rows.append((slo, rank, value, violated))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return rows
+
+
 def _render_top(latest: dict[int, dict]) -> str:
     """One frame of the cross-rank live view over the newest shard
     record per rank: per-rank liveness header, counters summed,
@@ -423,6 +442,18 @@ def _render_top(latest: dict[int, dict]) -> str:
                 f"{_fmt(stat['min']):>10} {_fmt(stat['max']):>10} "
                 f"{_fmt(stat['skew'], 3):>6}  rank {stat['max_rank']}"
             )
+    slo_rows = _slo_rows(latest)
+    if slo_rows:
+        lines.append("")
+        lines.append("slo (per rank, from obs/slo/* gauges):")
+        lines.append(
+            f"  {'name':<32} {'rank':>4} {'burn_rate':>10}  status"
+        )
+        for name, rank, burn, violated in slo_rows:
+            lines.append(
+                f"  {name:<32} {rank:>4} {_fmt(burn):>10}  "
+                + ("VIOLATED" if violated else "ok")
+            )
     if merged["histograms"]:
         lines.append("")
         lines.append("histograms (merged):")
@@ -463,6 +494,60 @@ def _top(args) -> int:
             _time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def _timeline(args) -> int:
+    """Render per-request waterfalls + the aggregate phase breakdown
+    from a run's persisted request timelines (reqtrace.jsonl +
+    exemplars.jsonl)."""
+    from rocket_tpu.obs.reqtrace import (
+        aggregate_phases,
+        read_timeline_dir,
+        render_aggregate,
+        render_waterfall,
+    )
+
+    records = read_timeline_dir(args.path)
+    if not records:
+        print(
+            f"error: no request timelines (reqtrace.jsonl / "
+            f"exemplars.jsonl) under {args.path} — was the run served "
+            "with reqtrace on and exporting?",
+            file=sys.stderr,
+        )
+        return 2
+    if args.request is not None:
+        selection = [r for r in records if r["rid"] == args.request]
+        if not selection:
+            known = ", ".join(str(r["rid"]) for r in records[:16])
+            print(
+                f"error: request {args.request} has no retained timeline "
+                f"(known: {known}{'...' if len(records) > 16 else ''})",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        selection = sorted(
+            records, key=lambda r: -(r.get("total_s") or 0.0)
+        )[:max(args.slowest, 1)]
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "requests": selection,
+                "aggregate": aggregate_phases(records),
+            },
+            indent=2, sort_keys=True,
+        ))
+        return 0
+    print(f"obs timeline — {len(records)} retained request(s), "
+          f"showing {len(selection)}")
+    for record in selection:
+        print()
+        print(render_waterfall(record))
+    print()
+    print(render_aggregate(records))
+    print("legend: . queue   # prefill   = decode   x preempted")
+    return 0
 
 
 def _watch(args) -> int:
@@ -672,6 +757,26 @@ def main(argv=None) -> int:
         help="SLO spec file (rocket_tpu.obs.slo grammar), or "
              "default:serve / default:train",
     )
+    timeline = sub.add_parser(
+        "timeline", help="render per-request waterfalls + phase "
+                         "breakdown from a serve run's request "
+                         "timelines (obs.reqtrace)"
+    )
+    timeline.add_argument(
+        "path", help="run dir (or its telemetry/ dir, or a "
+                     "reqtrace/exemplars jsonl file)"
+    )
+    timeline.add_argument(
+        "--request", type=int, default=None, metavar="ID",
+        help="render this request id's waterfall only",
+    )
+    timeline.add_argument(
+        "--slowest", type=int, default=3, metavar="N",
+        help="render the N slowest requests by total latency "
+             "(default: 3; ignored with --request)",
+    )
+    timeline.add_argument("--format", choices=("text", "json"),
+                          default="text")
     blackbox = sub.add_parser(
         "blackbox", help="render a flight-recorder forensic bundle"
     )
@@ -708,6 +813,8 @@ def main(argv=None) -> int:
         return _top(args)
     if args.command == "watch":
         return _watch(args)
+    if args.command == "timeline":
+        return _timeline(args)
     if args.command not in ("report", "blackbox"):
         parser.print_help()
         return 2

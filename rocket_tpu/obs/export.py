@@ -646,6 +646,15 @@ class TelemetryExporter:
             "metrics": snapshot,
         }
         self._seq += 1
+        tracer = getattr(tel, "reqtrace", None)
+        if tracer is not None:
+            # Close the request-timeline window FIRST: finished
+            # waterfalls + slowest-k exemplars land in the shard dir,
+            # and an SLO violation this tick can name the window's
+            # exemplar request ids in its anomaly.
+            record["reqtrace"] = tracer.flush(
+                tel.resolve_out_dir(self._default_dir)
+            )
         if self.slos is not None:
             self._evaluate_slos(record)
             # Re-snapshot so the shard carries its own obs/slo/* gauges.
@@ -673,6 +682,15 @@ class TelemetryExporter:
         statuses = self.slos.observe(
             record["t_unix"], record["metrics"], record["goodput"]
         )
+        tracer = getattr(self.telemetry, "reqtrace", None)
+        if tracer is not None:
+            # SLO-linked forensics: a violated serve SLO carries the
+            # offending window's exemplar request ids — the burn-rate
+            # page lands next to the exact waterfalls that caused it
+            # (`obs timeline <run> --request <id>`).
+            for status in statuses:
+                if status.violated:
+                    status.exemplars = dict(tracer.last_window)
         record["slo"] = [dataclasses.asdict(s) for s in statuses]
         for status in statuses:
             prefix = f"obs/slo/{status.name}"
@@ -689,11 +707,14 @@ class TelemetryExporter:
                 )
                 flight = getattr(self.telemetry, "flight", None)
                 if flight is not None:
-                    flight.note_anomaly({
+                    anomaly = {
                         "kind": "slo_violation",
                         "slo": status.name,
                         "burn_rate": status.burn_rate,
                         "value": status.value,
                         "objective": status.objective,
                         "t_unix": record["t_unix"],
-                    })
+                    }
+                    if status.exemplars is not None:
+                        anomaly["exemplars"] = status.exemplars
+                    flight.note_anomaly(anomaly)

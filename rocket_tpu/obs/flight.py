@@ -119,6 +119,13 @@ class FlightRecorder:
             self._anomalies.append(entry)
             del self._anomalies[:-64]
 
+    def anomalies(self) -> list[dict]:
+        """Snapshot of the retained anomaly ring (newest last) — what a
+        smoke/test asserts an SLO violation's forensics against without
+        forcing a dump."""
+        with self._lock:
+            return list(self._anomalies)
+
     def __len__(self) -> int:
         return len(self._ring)
 

@@ -131,6 +131,11 @@ class SLOStatus:
     #: True only on the healthy -> violated transition (the edge that
     #: increments the violation counter and notes the flight anomaly).
     newly_violated: bool = False
+    #: Tail forensics, attached by the exporter on violation when a
+    #: request tracer is wired: the offending window's slowest request
+    #: ids per dimension (``{"ttft": [...], "itl_gap": [...]}``) —
+    #: ``obs timeline --request <id>`` renders their waterfalls.
+    exemplars: Optional[dict] = None
 
 
 def _resolve_objective(entry: dict, base_dir: Optional[str]) -> float:

@@ -123,6 +123,12 @@ class Telemetry:
         #: are enabled. None otherwise — every use below is guarded.
         self.flight = None
         self.health = None
+        #: Serve-wired (rocket_tpu.obs.reqtrace): the per-request
+        #: timeline tracer a ServeEngine attaches, drained by the
+        #: exporter each window (finished timelines + tail exemplars
+        #: into the shard dir). None outside serving — guarded
+        #: everywhere.
+        self.reqtrace = None
         #: Runtime-wired (rocket_tpu.resilience): when a supervisor owns
         #: this process, watchdog ESCALATION (a genuinely wedged step, not
         #: one slow wave) exits with this code after the forensic dump so

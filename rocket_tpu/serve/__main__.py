@@ -143,6 +143,7 @@ def _run(args) -> int:
             max_model_len=args.max_model_len,
             prefill_chunk=args.prefill_chunk,
             decode_waves_per_dispatch=args.waves_per_dispatch,
+            reqtrace=not args.no_reqtrace,
         ),
         tokenizer=tokenizer,
         telemetry=telemetry,
@@ -190,6 +191,18 @@ def _run(args) -> int:
                 "serve: --trace-steps window captured no trace (window "
                 "past the last tick?)", file=sys.stderr,
             )
+
+    if engine.tracer is not None:
+        # Persist the final request-timeline window even when no live
+        # exporter is attached to drain it — the run dir always renders
+        # with `python -m rocket_tpu.obs timeline`.
+        engine.tracer.flush(telemetry.resolve_out_dir(args.out_dir))
+        print(
+            "serve: request timelines under "
+            f"{os.path.join(args.out_dir, 'telemetry')} — render with "
+            "`python -m rocket_tpu.obs timeline "
+            f"{args.out_dir} --slowest 3`", file=sys.stderr,
+        )
 
     report = engine.report()
     print(json.dumps({"serve_report": report}, indent=1, sort_keys=True))
@@ -301,6 +314,10 @@ def main(argv=None) -> int:
         p.add_argument("--slo", default=None, metavar="SPEC",
                        help="SLO spec file, or default:serve for the "
                        "committed ITL/TTFT objectives (env ROCKET_TPU_SLO)")
+        p.add_argument("--no-reqtrace", action="store_true",
+                       help="disable per-request timeline tracing "
+                       "(rocket_tpu.obs.reqtrace; on by default — "
+                       "host-side only, no effect on the compiled path)")
 
     rep = sub.add_parser("report", help="render a serve telemetry.json")
     rep.add_argument("path", help="telemetry.json or the run dir holding it")

@@ -58,6 +58,11 @@ class ServeConfig:
     #: long-running server's host memory stays bounded (``release()``
     #: drops one eagerly).
     max_completed_requests: int = 4096
+    #: Per-request timeline tracing (``rocket_tpu.obs.reqtrace``): ON by
+    #: default — the recorder is O(waves + requests) host dict work with
+    #: no device syncs, so steady-state tokens/sec is unchanged within
+    #: noise (gated by the serve bench + smoke). Set False to prove it.
+    reqtrace: bool = True
 
     def resolve(self, model_config) -> tuple[KVPoolSpec, int, int, int]:
         """``(pool_spec, max_blocks_per_seq, num_blocks,
@@ -157,6 +162,20 @@ class ServeEngine:
         self.scheduler = Scheduler(self.engine, BlockAllocator(num_blocks))
         self.tokenizer = tokenizer
         self.telemetry = telemetry
+        #: Per-request timeline recorder (None when cfg.reqtrace=False).
+        #: Exposed on the telemetry object so the exporter can drain
+        #: finished timelines + tail exemplars into the shard dir each
+        #: export window.
+        self.tracer = None
+        if cfg.reqtrace:
+            from rocket_tpu.obs.reqtrace import RequestTracer
+
+            self.tracer = RequestTracer(
+                max_records=max(cfg.max_completed_requests, 1)
+            )
+            self.scheduler.tracer = self.tracer
+            if telemetry is not None and getattr(telemetry, "enabled", False):
+                telemetry.reqtrace = self.tracer
         #: Owns every mutable record below AND the scheduler/engine tick
         #: path: ``submit``/``step``/``release``/``reset_metrics`` may be
         #: called from concurrent request threads (``stream()`` readers
@@ -205,9 +224,14 @@ class ServeEngine:
         eos_token_id: Optional[int] = None,
     ) -> int:
         """Enqueue one request; returns its id. ``prompt`` may be text
-        when a tokenizer is attached."""
+        when a tokenizer is attached. Refusals (invalid sampling knobs,
+        prompts the pool can never hold, text without a tokenizer) count
+        as ``serve/rejected_requests`` before re-raising — submit-time
+        rejections must not vanish from the metrics plane."""
         if isinstance(prompt, str):
             if self.tokenizer is None:
+                with self._lock:
+                    self._reject_locked()
                 raise ValueError(
                     "ServeEngine.submit: text prompt needs a tokenizer"
                 )
@@ -221,9 +245,31 @@ class ServeEngine:
             eos_token_id=eos_token_id,
         )
         with self._lock:
-            rid = self.scheduler.submit(req)
+            try:
+                rid = self.scheduler.submit(req)
+            except ValueError:
+                self._reject_locked()
+                raise
             self.requests[rid] = req
+            # Admission queue depth at SUBMIT granularity — a burst of
+            # arrivals between wave boundaries is visible to scrapes,
+            # not just the post-tick _publish() snapshot.
+            self._publish_queue_locked()
         return rid
+
+    def _reject_locked(self) -> None:
+        self.scheduler.rejected += 1
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.registry.counter("serve/rejected_requests").inc()
+            self._publish_queue_locked()
+
+    def _publish_queue_locked(self) -> None:
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.registry.gauge("serve/queue_depth").set(
+                self.scheduler.queue_depth
+            )
 
     # -- stepping ----------------------------------------------------------
 
@@ -243,6 +289,17 @@ class ServeEngine:
             self._trace_poll_locked()
             t0 = time.perf_counter()
             gets_before = self.engine.device_gets
+            if self.tracer is not None:
+                # Device-trace join: while a capture window is open this
+                # tick's wave record carries the StepTraceAnnotation
+                # step id, so a slow wave joins to its measured device
+                # window via the obs.prof parser.
+                self.tracer.trace_step = (
+                    self._ticks
+                    if self._trace_session is not None
+                    and self._trace_session.active
+                    else None
+                )
             if self._trace_session is not None and self._trace_session.active:
                 import jax
 
@@ -313,6 +370,11 @@ class ServeEngine:
         while len(self._finished_order) > cap:
             old = self._finished_order.pop(0)
             self.requests.pop(old, None)
+            if self.tracer is not None:
+                # Timeline retention follows Request retention — the
+                # finished record was already queued for persistence at
+                # finish time, so only the in-memory copy goes.
+                self.tracer.release(old)
 
     def release(self, rid: int) -> None:
         """Drop a finished request's record eagerly (long-running servers
@@ -328,6 +390,8 @@ class ServeEngine:
                 self._finished_order.remove(rid)
             except ValueError:
                 pass
+            if self.tracer is not None:
+                self.tracer.release(rid)
 
     # -- windowed device-trace capture -------------------------------------
 
@@ -400,6 +464,8 @@ class ServeEngine:
                 emitted += 1
                 yield detok.push(tok) if detok is not None else tok
             if req.finished:
+                if self.tracer is not None:
+                    self.tracer.on_detokenize(rid, time.perf_counter())
                 return
             if self.scheduler.idle:
                 raise RuntimeError(
@@ -430,6 +496,25 @@ class ServeEngine:
         tel.registry.histogram("serve/ttft_s", base=1e-4).observe(
             req.first_token_at - req.submitted_at
         )
+        if self.tracer is not None:
+            phases = self.tracer.phases(req.id)
+            if phases is not None:
+                # Per-phase latency distributions — where request wall
+                # time went, fleet-wide (the waterfall's aggregate twin).
+                reg = tel.registry
+                reg.histogram("serve/queue_wait_s", base=1e-6).observe(
+                    phases["queue_s"]
+                )
+                reg.histogram("serve/prefill_s", base=1e-6).observe(
+                    phases["prefill_s"]
+                )
+                reg.histogram("serve/decode_s", base=1e-6).observe(
+                    phases["decode_s"]
+                )
+                if phases["preempted_s"] > 0:
+                    reg.histogram(
+                        "serve/preempted_s", base=1e-6
+                    ).observe(phases["preempted_s"])
 
     def _publish(self) -> None:
         tel = self.telemetry
@@ -486,6 +571,7 @@ class ServeEngine:
             sched.preemptions = 0
             sched.tokens_generated = 0
             sched.waves_idle = 0
+            sched.rejected = 0
 
     def report(self) -> dict:
         """Latency/throughput summary for this engine's lifetime.
@@ -530,6 +616,7 @@ class ServeEngine:
                 "completed": sched.completed,
                 "queued": sched.queue_depth,
                 "preemptions": sched.preemptions,
+                "rejected": sched.rejected,
             },
             "tokens_generated": sched.tokens_generated,
             "tokens_per_sec": (
@@ -544,6 +631,11 @@ class ServeEngine:
                 "prefill_chunks": self.engine.prefill_chunks,
             },
             "dispatch": self._dispatch_stats_locked(),
+            # Retained-request phase breakdown + ITL-gap attribution
+            # (None with reqtrace off or nothing finished).
+            "phases": (
+                self.tracer.aggregate() if self.tracer is not None else None
+            ),
             "pool": {
                 "num_blocks": self.engine.spec.num_blocks,
                 "block_len": self.engine.spec.block_len,

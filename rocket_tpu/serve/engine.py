@@ -294,6 +294,11 @@ class SlotEngine:
         #: Cumulative seconds :meth:`harvest` spent blocked on the
         #: device fetch — what the host loop could NOT overlap.
         self.harvest_wait_s = 0.0
+        #: perf_counter instants of the most recent dispatch/harvest —
+        #: the tick-boundary timestamps request tracing reads (host
+        #: floats only; never a device sync).
+        self.last_dispatch_at: Optional[float] = None
+        self.last_harvest_at: Optional[float] = None
 
         def count_decode():
             self.decode_traces += 1
@@ -322,6 +327,7 @@ class SlotEngine:
         while the device runs, and :meth:`harvest` fetches the results."""
         self.decode_dispatches += 1
         self.decode_waves += self.waves_per_dispatch
+        self.last_dispatch_at = time.perf_counter()
         self.k_pages, self.v_pages, toks, done, emitted = self._decode(
             self._params, self.k_pages, self.v_pages, block_table, lengths,
             last_tok, run_mask, limits, temp, top_k, top_p, eos, seeds,
@@ -336,7 +342,8 @@ class SlotEngine:
         self.device_gets += 1
         t0 = time.perf_counter()
         out = jax.device_get(tuple(handle))
-        self.harvest_wait_s += time.perf_counter() - t0
+        self.last_harvest_at = time.perf_counter()
+        self.harvest_wait_s += self.last_harvest_at - t0
         return out
 
     def decode(self, block_table, lengths, last_tok, run_mask, limits,

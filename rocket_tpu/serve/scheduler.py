@@ -135,6 +135,13 @@ class Scheduler:
         #: The in-flight decode dispatch, harvested at the NEXT tick
         #: (dispatch-then-harvest pipelining).
         self.pending = None
+        #: Optional :class:`~rocket_tpu.obs.reqtrace.RequestTracer` —
+        #: every hook below is guarded, so a bare scheduler (tests,
+        #: audits) pays nothing.
+        self.tracer = None
+        #: The tracer's wave-record seq paired with ``pending`` — it
+        #: rides the same dispatch-then-harvest pipeline.
+        self._pending_seq = None
         self._next_id = 0
         self._admit_seq = 0
         # Aggregates for the report / gauges.
@@ -143,6 +150,7 @@ class Scheduler:
         self.preemptions = 0
         self.tokens_generated = 0
         self.waves_idle = 0
+        self.rejected = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -184,6 +192,11 @@ class Scheduler:
         req.submitted_at = time.perf_counter()
         self.queue.append(req)
         self.submitted += 1
+        if self.tracer is not None:
+            self.tracer.on_submit(
+                req.id, req.submitted_at, prompt_len=prompt.size,
+                max_new_tokens=req.max_new_tokens,
+            )
         return req.id
 
     # -- the serving step --------------------------------------------------
@@ -204,6 +217,14 @@ class Scheduler:
                 self.limits, self.temp, self.top_k, self.top_p, self.eos,
                 self.seeds,
             )
+            if self.tracer is not None:
+                # One shared wave record per dispatch (O(waves), not
+                # O(waves x slots)) — harvested with `pending` next tick.
+                self._pending_seq = self.tracer.on_dispatch(
+                    occupancy=int(run.sum()),
+                    t=self.engine.last_dispatch_at,
+                    waves=self.engine.waves_per_dispatch,
+                )
         elif self.pending is None and not events:
             self.waves_idle += 1
         return events
@@ -256,6 +277,11 @@ class Scheduler:
             self.top_p[slot] = 1.0 if req.top_p is None else req.top_p
             self.eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
             self.seeds[slot] = req.id % (2**31 - 1)
+            if self.tracer is not None:
+                self.tracer.on_admit(
+                    req.id, time.perf_counter(), slot, ctx_len=len(ctx),
+                    resumed=req.preemptions > 0,
+                )
 
     def _prefill_one(self) -> None:
         """One chunk for the OLDEST still-prefilling slot (FIFO keeps TTFT
@@ -282,6 +308,10 @@ class Scheduler:
         )
         st.prefill_pos = start + valid
         self.lengths[slot] = st.prefill_pos
+        if self.tracer is not None:
+            self.tracer.on_prefill(
+                st.req.id, time.perf_counter(), start, valid
+            )
 
     def _grow_tables(self) -> np.ndarray:
         """Cover every position the next dispatch may write — up to
@@ -336,6 +366,8 @@ class Scheduler:
         st.req.preemptions += 1
         self.preemptions += 1
         self.queue.appendleft(st.req)
+        if self.tracer is not None:
+            self.tracer.on_evict(st.req.id, time.perf_counter())
         self._clear(slot)
 
     def _harvest_pending(self) -> list[TickEvent]:
@@ -347,8 +379,13 @@ class Scheduler:
         if self.pending is None:
             return []
         handle, self.pending = self.pending, None
+        seq, self._pending_seq = self._pending_seq, None
         toks, done, emitted = self.engine.harvest(handle)
         now = time.perf_counter()
+        if self.tracer is not None and seq is not None:
+            self.tracer.on_harvest(seq, now)
+        emitted_by: dict[int, int] = {}
+        finished_ids: list[int] = []
         events = []
         for wave in range(toks.shape[0]):
             for slot in np.nonzero(emitted[wave])[0]:
@@ -362,12 +399,21 @@ class Scheduler:
                 self.lengths[slot] += 1
                 self.last_tok[slot] = tok
                 finished = bool(done[wave, slot])
+                emitted_by[st.req.id] = emitted_by.get(st.req.id, 0) + 1
                 if finished:
                     st.req.finished_at = now
                     self.completed += 1
                     self.allocator.free(st.blocks)
                     self._clear(int(slot))
+                    finished_ids.append(st.req.id)
                 events.append(TickEvent(st.req, tok, finished))
+        if self.tracer is not None and emitted_by:
+            # ONE participation event per request per dispatch — its k
+            # waves share a single harvest instant anyway.
+            for rid, n in emitted_by.items():
+                self.tracer.on_tokens(rid, seq, n, now)
+            for rid in finished_ids:
+                self.tracer.on_finish(rid, now)
         return events
 
     def _clear(self, slot: int) -> None:

@@ -150,7 +150,7 @@ echo "== resilience smoke (supervised restart after injected kill + SIGTERM drai
 # fresh supervised launch resumes from it).
 JAX_PLATFORMS=cpu python scripts/resilience_smoke.py
 
-echo "== serve smoke (continuous batching + paged KV + compiled-once + k-wave scan) =="
+echo "== serve smoke (continuous batching + paged KV + compiled-once + k-wave scan + request timelines) =="
 # A 50-request synthetic workload through rocket_tpu.serve plus the
 # python -m rocket_tpu.serve CLI: every request must complete, the decode
 # wave / prefill chunk must each compile exactly ONCE (zero retraces
@@ -158,7 +158,11 @@ echo "== serve smoke (continuous batching + paged KV + compiled-once + k-wave sc
 # telemetry.json), and greedy outputs must match generate(). The scanned
 # leg re-serves an identical workload with decode_waves_per_dispatch=4:
 # greedy outputs bit-identical to k=1, zero retraces, and exactly one
-# jax.device_get per dispatch of k waves (the tunnel amortization).
+# jax.device_get per dispatch of k waves (the tunnel amortization). The
+# timeline leg (obs.reqtrace) preempts+resumes requests on a starved
+# pool and gates the tail-forensics chain: one waterfall spanning both
+# residencies, phases summing to wall time within 5%, the seeded SLO
+# violation naming the window's exemplars, obs timeline rendering them.
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 echo "== tier-1 tests =="

@@ -24,6 +24,13 @@ Four legs (wired into scripts/check.sh and CI):
 4. **CLI**: ``python -m rocket_tpu.serve`` as a subprocess (with a
    k-wave flag) must stream output, print the serve report, exit 0, and
    the ``report`` subcommand must render its telemetry.
+5. **Timeline** (ISSUE 20): per-request tail forensics end to end — a
+   starved pool preempts + resumes requests whose single timeline spans
+   both residencies (eviction gap visible, phase durations summing to
+   the measured wall time within 5%), the seeded ITL-p99 SLO violation
+   names the window's tail exemplars in its flight anomaly, and
+   ``python -m rocket_tpu.obs timeline`` renders the waterfalls from the
+   persisted shards.
 
 Exits non-zero on the first violated invariant.
 """
@@ -328,6 +335,124 @@ def cli_leg(out_dir: str) -> None:
     print("serve smoke: CLI leg OK")
 
 
+def timeline_leg(out_dir: str) -> None:
+    """Per-request tail forensics (ISSUE 20): preempted+resumed
+    waterfalls, the SLO-violation -> exemplar link, and the timeline
+    CLI over the persisted shards."""
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    from rocket_tpu.obs.export import ExportConfig, TelemetryExporter
+    from rocket_tpu.obs.flight import FlightRecorder
+    from rocket_tpu.obs.telemetry import Telemetry
+    from rocket_tpu.serve import ServeConfig, ServeEngine
+
+    os.makedirs(out_dir, exist_ok=True)
+    violating = os.path.join(out_dir, "slo_itl_tight.json")
+    with open(violating, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "slos": [
+            {"name": "seeded_itl_p99", "kind": "quantile",
+             "metric": "serve/itl_s", "quantile": 0.99,
+             "objective": 1e-12},
+        ]}, f)
+
+    config = TransformerConfig(
+        vocab_size=64, max_seq_len=64, dim=32, num_layers=2, num_heads=4,
+        dropout=0.0,
+    )
+    model = TransformerLM(config)
+    variables = jax.jit(model.init)(jax.random.key(0))
+    telemetry = Telemetry(enabled=True, out_dir=out_dir)
+    telemetry.start()
+    telemetry.flight = FlightRecorder(telemetry=telemetry)
+    engine = ServeEngine(
+        model, variables["params"],
+        # Starved pool (8 allocatable blocks, 4 slots): decode growth
+        # exhausts it, so the youngest active request preempts and
+        # resumes — the tail shape this leg exists to trace.
+        ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                    max_model_len=32, num_blocks=9),
+        telemetry=telemetry,
+    )
+    # Warmup pays the two compiles, then the tracer window resets so the
+    # measured waterfalls carry no compile time in their phases.
+    for _ in range(2):
+        engine.submit(np.asarray([1, 2], np.int32), max_new_tokens=2,
+                      temperature=0.0)
+    engine.drain()
+    engine.tracer.flush(out_dir)
+
+    rng = np.random.default_rng(3)
+    rids = []
+    for _ in range(8):
+        prompt = rng.integers(0, 64, size=int(rng.integers(2, 7)))
+        rids.append(engine.submit(prompt.astype(np.int32),
+                                  max_new_tokens=int(rng.integers(10, 16)),
+                                  temperature=0.0))
+    engine.drain()
+    preempted = [r for r in rids if engine.result(r).preemptions > 0]
+    check(preempted, "starved pool produced no preemption to trace")
+
+    # One synchronous exporter tick: flushes the measured window's
+    # timelines + exemplars, then evaluates the seeded SLO against them.
+    exporter = TelemetryExporter(
+        telemetry, ExportConfig(enabled=True, slo_path=violating),
+        identity={"rank": 0, "hostname": "smoke", "pid": os.getpid()},
+        default_dir=out_dir,
+    )
+    record = exporter.tick()
+    check(record["reqtrace"]["finished"] == 8,
+          f"reqtrace window drained {record['reqtrace']} (want 8 finished)")
+    verdict, = [s for s in record["slo"] if s["name"] == "seeded_itl_p99"]
+    check(verdict["violated"], f"seeded ITL SLO not violated: {verdict}")
+    exemplars = verdict.get("exemplars") or {}
+    named = set(exemplars.get("itl_gap", [])) | set(exemplars.get("ttft", []))
+    check(named, f"violation carries no exemplars: {verdict}")
+    check(set(preempted) & named,
+          f"preempted request(s) {preempted} not among the violation's "
+          f"tail exemplars {exemplars}")
+    anomaly = [a for a in telemetry.flight.anomalies()
+               if a.get("kind") == "slo_violation"][-1]
+    check(anomaly.get("exemplars") == exemplars,
+          f"flight anomaly exemplars diverge: {anomaly}")
+    telemetry.close(write=False)
+    for name in ("reqtrace.jsonl", "exemplars.jsonl"):
+        path = os.path.join(out_dir, "telemetry", name)
+        check(os.path.exists(path), f"{path} not persisted")
+
+    # The timeline CLI over the persisted shards: the preempted request's
+    # waterfall shows the eviction gap, one timeline spanning BOTH
+    # residencies, phases summing to the measured wall time within 5%.
+    victim = preempted[0]
+    cli = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "timeline", out_dir,
+         "--request", str(victim), "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    check(cli.returncode == 0,
+          f"obs timeline --request exited {cli.returncode}: {cli.stderr}")
+    rec, = json.loads(cli.stdout)["requests"]
+    kinds = [e["ev"] for e in rec["events"]]
+    check("evict" in kinds, f"no evict event on the waterfall: {kinds}")
+    check(any(e.get("resumed") for e in rec["events"]
+              if e["ev"] == "admit"),
+          "no resumed re-admission on the preempted timeline")
+    check(rec["phases"]["preempted_s"] > 0, f"no eviction gap: {rec['phases']}")
+    phase_sum = sum(rec["phases"].values())
+    check(abs(phase_sum - rec["total_s"]) <= 0.05 * rec["total_s"],
+          f"phases {phase_sum} vs wall {rec['total_s']} beyond 5%")
+
+    slowest = subprocess.run(
+        [sys.executable, "-m", "rocket_tpu.obs", "timeline", out_dir,
+         "--slowest", "3"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    check(slowest.returncode == 0,
+          f"obs timeline --slowest exited {slowest.returncode}: "
+          f"{slowest.stderr}")
+    check("aggregate" in slowest.stdout, "no aggregate phase breakdown")
+    print(f"serve smoke: timeline leg OK (preempted {preempted} traced, "
+          f"exemplars {exemplars})")
+
+
 def main() -> None:
     repo_runs = os.path.join(REPO, "runs")
     os.makedirs(repo_runs, exist_ok=True)
@@ -338,6 +463,7 @@ def main() -> None:
     export_leg(os.path.join(workdir, "export"))
     scan_leg()
     cli_leg(os.path.join(workdir, "cli"))
+    timeline_leg(os.path.join(workdir, "timeline"))
     print("serve smoke: all checks passed")
 
 

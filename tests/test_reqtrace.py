@@ -1,0 +1,310 @@
+"""rocket_tpu.obs.reqtrace — per-request tail-latency tracing contracts:
+timeline event ordering + the exact phase partition, eviction-resume
+spanning one timeline, exemplar selection math and shard persistence,
+SLO-violation → exemplar linkage through the exporter + flight recorder,
+and the `obs timeline` CLI exit/json contracts.
+
+Deliberately jax-free (like test_export.py): the tracer is stdlib dicts
+driven with synthetic clocks — no engine, no backend. The live-engine
+overhead contract (reqtrace on vs off: identical wave counts, zero extra
+device transfers, identical outputs) lives in test_serve.py.
+"""
+
+import json
+
+import pytest
+
+from rocket_tpu.obs.export import ExportConfig, TelemetryExporter, read_shard_file
+from rocket_tpu.obs.reqtrace import (
+    EXEMPLARS_FILE,
+    REQTRACE_FILE,
+    RequestTracer,
+    aggregate_phases,
+    read_timeline_dir,
+    render_aggregate,
+    render_waterfall,
+    timeline_segments,
+)
+from rocket_tpu.obs.telemetry import Telemetry
+
+
+def _drive(tracer, rid, t0, *, queue=0.5, prefill=0.4, waves=((0.2, 1), (0.2, 1))):
+    """One full request lifecycle on a synthetic clock: submit at t0,
+    admit after `queue`, first wave after `prefill`, then one wave per
+    (dt, n) pair, finishing on the last. Returns the finish time."""
+    tracer.on_submit(rid, t0, prompt_len=4, max_new_tokens=len(waves))
+    t = t0 + queue
+    tracer.on_admit(rid, t, slot=0, ctx_len=4)
+    tracer.on_prefill(rid, t, 0, 3)
+    for i, (dt, n) in enumerate(waves):
+        # The first wave lands `prefill` after admit; its dt is unused
+        # (ttft = queue + prefill by construction).
+        t = (t0 + queue + prefill) if i == 0 else t + dt
+        seq = tracer.on_dispatch(occupancy=1, t=t - 0.01)
+        tracer.on_harvest(seq, t)
+        tracer.on_tokens(rid, seq, n, t)
+    tracer.on_finish(rid, t)
+    return t
+
+
+# -- timeline contract ------------------------------------------------------
+
+
+def test_lifecycle_event_ordering_and_exact_phase_partition():
+    tracer = RequestTracer()
+    tracer.on_submit(1, 10.0, prompt_len=4, max_new_tokens=2)
+    tracer.on_admit(1, 10.5, slot=3, ctx_len=4)
+    tracer.on_prefill(1, 10.6, 0, 3)
+    seq = tracer.on_dispatch(occupancy=2, t=10.7, waves=1)
+    tracer.on_harvest(seq, 10.9)
+    tracer.on_tokens(1, seq, 1, 10.9)
+    seq2 = tracer.on_dispatch(occupancy=2, t=10.95)
+    tracer.on_harvest(seq2, 11.1)
+    tracer.on_tokens(1, seq2, 1, 11.1)
+    tracer.on_finish(1, 11.1)
+
+    rec = tracer.timeline(1)
+    assert rec["final"] and rec["rid"] == 1 and rec["tokens"] == 2
+    assert rec["ttft_s"] == pytest.approx(0.9)
+    assert rec["total_s"] == pytest.approx(1.1)
+    # The phase partition sums EXACTLY to the measured wall time.
+    phases = rec["phases"]
+    assert phases["queue_s"] == pytest.approx(0.5)
+    assert phases["prefill_s"] == pytest.approx(0.4)
+    assert phases["decode_s"] == pytest.approx(0.2)
+    assert phases["preempted_s"] == 0.0
+    assert sum(phases.values()) == pytest.approx(rec["total_s"], rel=1e-6)
+    # Event stream: lifecycle order, relative times monotone.
+    kinds = [e["ev"] for e in rec["events"]]
+    assert kinds == ["submit", "admit", "prefill", "wave", "wave", "finish"]
+    times = [e["t"] for e in rec["events"]]
+    assert times == sorted(times) and times[0] == 0.0
+    # The shared wave record's join fields ride the participation event.
+    wave = rec["events"][3]
+    assert wave["seq"] == seq and wave["occ"] == 2
+    assert wave["lat"] == pytest.approx(0.2)
+    # ITL gap between the two harvests, attributed to waiting-on-wave.
+    assert rec["itl"]["worst_gap_s"] == pytest.approx(0.2)
+    assert rec["itl"]["worst_gap_kind"] == "waiting"
+    # Segments partition [0, total] with no holes.
+    segs = timeline_segments(rec)
+    assert segs[0][1] == 0.0 and segs[-1][2] == pytest.approx(1.1)
+    for (_, _, end), (_, start, _) in zip(segs, segs[1:]):
+        assert start == pytest.approx(end)
+
+
+def test_eviction_resume_is_one_timeline_spanning_both_residencies():
+    tracer = RequestTracer()
+    tracer.on_submit(7, 0.0, prompt_len=2, max_new_tokens=8)
+    tracer.on_admit(7, 1.0, slot=0, ctx_len=2)
+    s0 = tracer.on_dispatch(occupancy=1, t=1.9)
+    tracer.on_harvest(s0, 2.0)
+    tracer.on_tokens(7, s0, 1, 2.0)
+    tracer.on_evict(7, 3.0)
+    # Second residency: re-admitted with progress folded into ctx.
+    tracer.on_admit(7, 5.0, slot=1, ctx_len=3, resumed=True)
+    s1 = tracer.on_dispatch(occupancy=1, t=5.9)
+    tracer.on_harvest(s1, 6.0)
+    tracer.on_tokens(7, s1, 1, 6.0)
+    tracer.on_finish(7, 7.0)
+
+    rec = tracer.timeline(7)
+    assert rec["preemptions"] == 1 and rec["tokens"] == 2
+    kinds = [e["ev"] for e in rec["events"]]
+    assert kinds == ["submit", "admit", "wave", "evict", "admit", "wave",
+                     "finish"]
+    assert rec["events"][4]["resumed"] is True
+    phases = rec["phases"]
+    assert phases["queue_s"] == pytest.approx(1.0)    # 0 -> first admit
+    assert phases["preempted_s"] == pytest.approx(2.0)  # evict -> re-admit
+    assert phases["prefill_s"] == pytest.approx(2.0)  # 1->2 plus 5->6
+    assert phases["decode_s"] == pytest.approx(2.0)   # 2->3 plus 6->7
+    assert sum(phases.values()) == pytest.approx(7.0)
+    # The eviction gap dominates ITL and is attributed to descheduling.
+    assert rec["itl"]["worst_gap_s"] == pytest.approx(4.0)
+    assert rec["itl"]["worst_gap_kind"] == "descheduled"
+    assert rec["itl"]["descheduled_s"] == pytest.approx(4.0)
+    # The waterfall shows the preemption hole.
+    assert ("preempted", 3.0, 5.0) in [
+        (k, round(a, 6), round(b, 6)) for k, a, b in timeline_segments(rec)
+    ]
+    assert "x" in render_waterfall(rec)
+
+
+def test_event_cap_compacts_waves_but_keeps_exact_accounting():
+    tracer = RequestTracer(max_events=16)
+    tracer.on_submit(1, 0.0, prompt_len=2, max_new_tokens=100)
+    tracer.on_admit(1, 1.0, slot=0, ctx_len=2)
+    t = 1.0
+    for _ in range(100):
+        t += 0.5
+        seq = tracer.on_dispatch(occupancy=1, t=t - 0.1)
+        tracer.on_harvest(seq, t)
+        tracer.on_tokens(1, seq, 1, t)
+    tracer.on_finish(1, t)
+    rec = tracer.timeline(1)
+    assert len(rec["events"]) <= 16
+    assert rec["tokens"] == 100
+    spans = [e for e in rec["events"] if e["ev"] == "wave_span"]
+    assert spans, "coalesced wave spans expected past the event cap"
+    assert sum(e["n"] for e in rec["events"]
+               if e["ev"] in ("wave", "wave_span")) == 100
+    # Incremental accounting is immune to compaction.
+    assert rec["phases"]["prefill_s"] == pytest.approx(0.5)  # 1.0 -> 1.5
+    assert rec["phases"]["decode_s"] == pytest.approx(49.5)
+    assert sum(rec["phases"].values()) == pytest.approx(rec["total_s"])
+
+
+def test_release_drops_live_and_finished_timelines():
+    tracer = RequestTracer()
+    _drive(tracer, 1, 0.0)
+    tracer.on_submit(2, 5.0, prompt_len=1, max_new_tokens=1)
+    assert tracer.timeline(1) is not None
+    assert tracer.timeline(2) is not None and not tracer.timeline(2)["final"]
+    tracer.release(1)
+    tracer.release(2)
+    assert tracer.timeline(1) is None and tracer.timeline(2) is None
+
+
+# -- exemplar selection + persistence ---------------------------------------
+
+
+def test_exemplar_selection_math_and_shard_persistence(tmp_path):
+    tracer = RequestTracer(exemplar_k=2)
+    # ttft (queue + prefill), slowest first: 3, 2, 1 — worst inter-wave
+    # gap: 2, 3, 1.
+    _drive(tracer, 1, 0.0, queue=0.1, waves=((0.1, 1), (0.1, 1)))
+    _drive(tracer, 2, 10.0, queue=0.2, waves=((0.1, 1), (3.0, 1)))
+    _drive(tracer, 3, 20.0, queue=5.0, waves=((0.1, 1), (1.0, 1)))
+    out = tracer.flush(str(tmp_path))
+    assert out["finished"] == 3 and out["persisted"] == 3
+    assert tracer.last_window["ttft"] == [3, 2]
+    assert tracer.last_window["itl_gap"] == [2, 3]
+    assert out["exemplars"] == tracer.last_window
+    # Shard discipline: both files are crash-readable JSONL.
+    reqtrace = read_shard_file(str(tmp_path / "telemetry" / REQTRACE_FILE))
+    assert sorted(r["rid"] for r in reqtrace) == [1, 2, 3]
+    exemplars = read_shard_file(str(tmp_path / "telemetry" / EXEMPLARS_FILE))
+    tagged = {(r["exemplar"]["by"], r["exemplar"]["rank"]): r["rid"]
+              for r in exemplars}
+    assert tagged[("ttft", 0)] == 3 and tagged[("itl_gap", 0)] == 2
+    # The next window starts empty — nothing re-persisted.
+    again = tracer.flush(str(tmp_path))
+    assert again["finished"] == 0 and again["persisted"] == 0
+    assert again["exemplars"] == {"ttft": [], "itl_gap": []}
+    # The reader dedupes exemplar copies into tags on one record.
+    records = read_timeline_dir(str(tmp_path))
+    by_rid = {r["rid"]: r for r in records}
+    assert len(records) == 3
+    # rids 2 and 3 are tail exemplars on BOTH dimensions with k=2;
+    # rid 1 is ordinary.
+    assert by_rid[2]["exemplar_by"] == ["ttft", "itl_gap"]
+    assert by_rid[3]["exemplar_by"] == ["ttft", "itl_gap"]
+    assert by_rid[1]["exemplar_by"] == []
+
+
+def test_aggregate_phase_fractions():
+    tracer = RequestTracer()
+    _drive(tracer, 1, 0.0)
+    _drive(tracer, 2, 10.0, queue=1.0)
+    agg = aggregate_phases([tracer.timeline(1), tracer.timeline(2)])
+    assert agg["requests"] == 2
+    fracs = [agg[k] for k in ("queue_frac", "prefill_frac", "decode_frac",
+                              "preempted_frac")]
+    assert sum(fracs) == pytest.approx(1.0, abs=1e-3)
+    assert "worst" in render_aggregate(
+        [tracer.timeline(1), tracer.timeline(2)]
+    )
+    assert aggregate_phases([]) is None
+
+
+# -- SLO-violation -> exemplar linkage --------------------------------------
+
+
+def test_slo_violation_carries_window_exemplars_into_flight(tmp_path):
+    from rocket_tpu.obs.flight import FlightRecorder
+
+    spec_file = tmp_path / "slo.json"
+    spec_file.write_text(json.dumps({"version": 1, "slos": [
+        {"name": "steps_floor", "kind": "gauge_min",
+         "metric": "perf/steps_per_sec", "objective": 100.0},
+    ]}))
+    telemetry = Telemetry(enabled=True, out_dir=str(tmp_path / "run"))
+    telemetry.registry.gauge("perf/steps_per_sec").set(5.0)  # violating
+    telemetry.flight = FlightRecorder(telemetry=telemetry)
+    tracer = RequestTracer()
+    _drive(tracer, 11, 0.0, queue=2.0)
+    _drive(tracer, 12, 1.0, queue=0.1)
+    telemetry.reqtrace = tracer
+    exporter = TelemetryExporter(
+        telemetry,
+        ExportConfig(enabled=True, slo_path=str(spec_file)),
+        identity={"rank": 0, "hostname": "testhost", "pid": 1},
+    )
+    record = exporter.tick()
+    # The exporter drained the tracer's window into the shard dir...
+    assert record["reqtrace"]["finished"] == 2
+    assert (tmp_path / "run" / "telemetry" / REQTRACE_FILE).exists()
+    # ...and the violation names the window's exemplar request ids,
+    # both on the shard record and in the flight anomaly.
+    verdict, = [s for s in record["slo"] if s["name"] == "steps_floor"]
+    assert verdict["violated"]
+    assert verdict["exemplars"]["ttft"] == [11, 12]
+    anomaly = telemetry.flight.anomalies()[-1]
+    assert anomaly["kind"] == "slo_violation"
+    assert anomaly["exemplars"]["ttft"] == [11, 12]
+
+
+# -- the obs timeline CLI ---------------------------------------------------
+
+
+def test_timeline_cli_contracts(tmp_path, capsys):
+    from rocket_tpu.obs.__main__ import main
+
+    run = tmp_path / "run"
+    tracer = RequestTracer()
+    _drive(tracer, 1, 0.0, queue=4.0)
+    _drive(tracer, 2, 1.0)
+    _drive(tracer, 3, 2.0)
+    tracer.flush(str(run))
+
+    assert main(["timeline", str(run), "--slowest", "2"]) == 0
+    text = capsys.readouterr().out
+    assert "request 1" in text and "queue" in text
+    assert main(["timeline", str(run), "--request", "2"]) == 0
+    assert "request 2" in capsys.readouterr().out
+
+    assert main(["timeline", str(run), "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert sorted(doc) == ["aggregate", "requests"]
+    assert len(doc["requests"]) == 3
+    assert doc["aggregate"]["requests"] == 3
+    for rec in doc["requests"]:
+        # The rendered phase durations sum to the measured wall time.
+        assert sum(rec["phases"].values()) == pytest.approx(
+            rec["total_s"], rel=0.05
+        )
+
+    assert main(["timeline", str(run), "--request", "999"]) == 2
+    assert main(["timeline", str(tmp_path / "void")]) == 2
+
+
+def test_top_renders_slo_column(tmp_path):
+    """Satellite: obs top shows the obs/slo/* gauges already riding the
+    shards as a per-rank SLO column."""
+    from rocket_tpu.obs.__main__ import _render_top, _slo_rows
+
+    latest = {
+        0: {"t_unix": 0, "metrics": {"gauges": {
+            "obs/slo/itl_p99/burn_rate": 2.5,
+            "obs/slo/itl_p99/violated": 1.0,
+        }}},
+        1: {"t_unix": 0, "metrics": {"gauges": {
+            "obs/slo/itl_p99/burn_rate": 0.4,
+            "obs/slo/itl_p99/violated": 0.0,
+        }}},
+    }
+    rows = _slo_rows(latest)
+    assert rows == [("itl_p99", 0, 2.5, True), ("itl_p99", 1, 0.4, False)]
+    frame = _render_top(latest)
+    assert "slo (per rank" in frame and "VIOLATED" in frame and "ok" in frame

@@ -310,6 +310,90 @@ def test_completed_request_retention_cap_and_release(tiny_lm):
     assert report["compiled"]["decode_traces"] == 1
 
 
+def test_release_and_retention_drop_request_timelines(tiny_lm):
+    """Timeline retention follows Request retention: release() and the
+    max_completed_requests cap both drop the reqtrace record, so a
+    week-long server keeps bounded timeline memory."""
+    model, variables = tiny_lm
+    engine = ServeEngine(
+        model, variables["params"],
+        ServeConfig(max_slots=2, block_len=4, prefill_chunk=4,
+                    max_model_len=16, max_completed_requests=3),
+    )
+    rids = [engine.submit(np.asarray([1, 2], np.int32), max_new_tokens=2)
+            for _ in range(5)]
+    engine.drain()
+    # The cap evicted the two oldest timelines along with their Requests.
+    assert engine.tracer.timeline(rids[0]) is None
+    assert engine.tracer.timeline(rids[1]) is None
+    kept = engine.tracer.timeline(rids[2])
+    assert kept is not None and kept["final"] and kept["tokens"] == 2
+    assert [e["ev"] for e in kept["events"]][0] == "submit"
+    assert [e["ev"] for e in kept["events"]][-1] == "finish"
+    engine.release(rids[2])
+    assert rids[2] not in engine.requests
+    assert engine.tracer.timeline(rids[2]) is None
+    # Phase aggregate over what's retained still renders in report().
+    assert engine.report()["phases"]["requests"] == 2
+
+
+def test_reqtrace_overhead_bound_and_rejection_counter(tiny_lm):
+    """The tracing contract: reqtrace on vs off drives IDENTICAL device
+    work (same dispatch/wave/transfer counts, same outputs) — the
+    recorder is host dicts only. Also pins submit-time rejections
+    landing in serve/rejected_requests instead of vanishing."""
+    model, variables = tiny_lm
+
+    def run(reqtrace: bool):
+        engine = ServeEngine(
+            model, variables["params"],
+            ServeConfig(max_slots=4, block_len=4, prefill_chunk=4,
+                        max_model_len=32, num_blocks=9, reqtrace=reqtrace),
+        )
+        rng = np.random.default_rng(7)
+        rids = [
+            engine.submit(
+                rng.integers(0, 64, size=int(rng.integers(2, 10))).astype(
+                    np.int32
+                ),
+                max_new_tokens=int(rng.integers(4, 10)),
+            )
+            for _ in range(8)
+        ]
+        engine.drain()
+        outputs = [list(engine.result(rid).tokens) for rid in rids]
+        eng = engine.engine
+        return engine, outputs, (
+            eng.decode_dispatches, eng.decode_waves, eng.device_gets,
+            eng.prefill_chunks,
+        )
+
+    traced, out_on, counts_on = run(reqtrace=True)
+    plain, out_off, counts_off = run(reqtrace=False)
+    assert counts_on == counts_off, "reqtrace changed device work"
+    assert out_on == out_off
+    assert plain.tracer is None and traced.tracer is not None
+    # Every request's timeline closed with the same token count.
+    for rid, tokens in enumerate(out_on):
+        rec = traced.tracer.timeline(rid)
+        assert rec["final"] and rec["tokens"] == len(tokens)
+        assert abs(sum(rec["phases"].values()) - rec["total_s"]) \
+            <= 0.05 * rec["total_s"] + 1e-9
+    # Preempted requests carry the eviction on their one timeline.
+    assert traced.report()["requests"]["preemptions"] > 0
+    evicted = [r for r in range(8)
+               if traced.tracer.timeline(r)["preemptions"] > 0]
+    assert evicted, "starved pool should have preempted someone"
+    for rid in evicted:
+        assert traced.tracer.timeline(rid)["phases"]["preempted_s"] > 0
+    # Submit-time refusals count instead of vanishing.
+    with pytest.raises(ValueError):
+        traced.submit(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError):
+        traced.submit("text", max_new_tokens=2)  # no tokenizer attached
+    assert traced.report()["requests"]["rejected"] == 2
+
+
 def test_generate_accepts_numpy_integer_scalars(tiny_lm):
     """np.int64 scalars (rng.integers() output) must route to the scalar
     path, not be mistaken for per-sequence arrays."""
